@@ -1,0 +1,15 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Re-implements the capability surface of 2017-era PaddlePaddle
+(reference: onelcq/Paddle) on JAX/XLA/Pallas: a config-driven layer engine,
+v2-style Python API, trainer CLI, data-parallel + sharded-embedding
+distribution over a ``jax.sharding.Mesh``, and a ProgramDesc→Executor graph
+runtime that lowers whole blocks to single XLA computations.
+"""
+
+__version__ = "0.1.0"
+
+from . import core, utils
+from .utils import FLAGS
+
+__all__ = ["core", "utils", "FLAGS", "__version__"]

@@ -1,0 +1,24 @@
+from .device import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    build_mesh,
+    data_sharding,
+    get_mesh,
+    is_tpu,
+    num_data_shards,
+    replicated,
+    set_mesh,
+)
+from .dtypes import Policy, current_policy, full_precision, policy_scope
+from .sequence import (
+    NestedSequenceBatch,
+    SequenceBatch,
+    flat_to_padded,
+    lengths_to_lod,
+    like,
+    lod_to_lengths,
+    pad_batch,
+    pad_nested_batch,
+    padded_to_flat,
+    value_of,
+)

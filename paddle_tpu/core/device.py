@@ -1,0 +1,101 @@
+"""Device and mesh management.
+
+TPU-native replacement for the reference's device layer
+(``paddle/platform/place.h:24-71`` CPUPlace/GPUPlace,
+``paddle/platform/device_context.h:38-72``, ``paddle/cuda`` device mgmt):
+on TPU the unit of execution is not "a device" but a **mesh** of devices that
+one jit-compiled program spans.  ``get_mesh()`` builds the process-global
+``jax.sharding.Mesh`` from ``FLAGS.mesh_shape`` (or all local devices on a
+``data`` axis), and the named-sharding helpers below are what layers and the
+trainer use instead of per-device placement.
+
+Axis conventions (used across paddle_tpu.parallel):
+  ``data``  — batch (data parallel / DP)
+  ``model`` — weight sharding (tensor parallel / sparse table sharding)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import FLAGS, PaddleTpuError, get_logger
+
+log = get_logger("device")
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_mesh: Optional[Mesh] = None
+
+
+def parse_mesh_shape(spec: str) -> Dict[str, int]:
+    """Parse ``'data=4,model=2'`` into an ordered axis→size dict."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise PaddleTpuError(f"bad mesh_shape component {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = int(v)
+    return out
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if not axes:
+        axes = {DATA_AXIS: len(devices)}
+    n = int(np.prod(list(axes.values())))
+    if n > len(devices):
+        raise PaddleTpuError(
+            f"mesh {axes} needs {n} devices, have {len(devices)}"
+        )
+    dev_array = np.array(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def get_mesh(refresh: bool = False) -> Mesh:
+    global _mesh
+    if _mesh is None or refresh:
+        axes = parse_mesh_shape(FLAGS.mesh_shape) if FLAGS.mesh_shape else None
+        _mesh = build_mesh(axes)
+        log.info("mesh: %s over %d %s device(s)",
+                 dict(zip(_mesh.axis_names, _mesh.devices.shape)),
+                 _mesh.devices.size, _mesh.devices.flat[0].platform)
+    return _mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def data_sharding(mesh: Optional[Mesh] = None, rank: int = 2) -> NamedSharding:
+    """Batch-dim sharded over ``data``, rest replicated."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS, *(None,) * (rank - 1)))
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())
+
+
+def num_data_shards(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape.get(DATA_AXIS, 1)
+
+
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def is_tpu() -> bool:
+    return jax.devices()[0].platform in ("tpu", "axon")

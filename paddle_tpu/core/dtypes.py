@@ -1,0 +1,67 @@
+"""Precision policy.
+
+The reference computes in fp32 (fp64 behind ``WITH_DOUBLE``); on TPU the MXU
+wants bfloat16 inputs with fp32 accumulation.  The policy object carries the
+three dtypes modern mixed-precision uses (param/compute/output) and is what
+layers consult instead of hard-coding dtypes.  ``checkgrad`` mode forces full
+fp32 so finite-difference tolerances hold (SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator
+
+import jax.numpy as jnp
+
+from ..utils import FLAGS
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, *xs):
+        out = tuple(
+            x.astype(self.compute_dtype)
+            if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x
+            for x in xs
+        )
+        return out if len(out) != 1 else out[0]
+
+    def cast_output(self, x):
+        if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.output_dtype)
+        return x
+
+
+_f32 = Policy(jnp.float32, jnp.float32, jnp.float32)
+_bf16 = Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+
+_override: list = []
+
+
+def current_policy() -> Policy:
+    if _override:
+        return _override[-1]
+    return _bf16 if FLAGS.use_bf16 else _f32
+
+
+@contextlib.contextmanager
+def policy_scope(policy: Policy) -> Iterator[None]:
+    _override.append(policy)
+    try:
+        yield
+    finally:
+        _override.pop()
+
+
+@contextlib.contextmanager
+def full_precision() -> Iterator[None]:
+    """fp32 everywhere — used by the gradient checker."""
+    with policy_scope(_f32):
+        yield

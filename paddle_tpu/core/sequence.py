@@ -1,0 +1,232 @@
+"""Variable-length (and nested) sequence representation.
+
+The reference threads sequences through the whole engine as a flat value
+matrix plus start-offset vectors — ``Argument.sequenceStartPositions`` /
+``subSequenceStartPositions`` (``paddle/parameter/Argument.h:84-90``), later
+generalized as ``LoDTensor`` (``paddle/framework/lod_tensor.h:57-80``).
+Offsets imply dynamic shapes, which XLA cannot compile efficiently.
+
+TPU-first re-design: a :class:`SequenceBatch` is a **dense padded** array
+``data[B, T, ...]`` plus an int32 ``length[B]`` vector, a static pytree that
+jit/scan/shard_map handle natively.  Masks and segment ids are derived inside
+the compiled program (free — they fuse into neighbors).  Nested sequences
+(sequence-of-subsequence, LoD level 2) are ``data[B, S, T, ...]`` with
+``num_subseq[B]`` and ``sub_length[B, S]``.
+
+Host-side, :func:`pad_batch` converts ragged Python/numpy data into a padded
+batch (optionally bucketing T to reduce recompilation), and
+:func:`lod_to_lengths` / :func:`lengths_to_lod` translate to and from the
+reference's offset convention so v1/v2-style data providers keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import PaddleTpuError, enforce
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SequenceBatch:
+    """Padded batch of variable-length sequences (LoD level 1).
+
+    data:   [B, T, ...] padded values (padding contents are arbitrary).
+    length: [B] int32 valid lengths, 0 <= length <= T.
+    """
+
+    data: jax.Array
+    length: jax.Array
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, T] 1.0 where valid."""
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return (t[None, :] < self.length[:, None]).astype(dtype)
+
+    def bool_mask(self) -> jax.Array:
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return t[None, :] < self.length[:, None]
+
+    def masked_data(self, fill: float = 0.0) -> jax.Array:
+        m = self.bool_mask()
+        m = m.reshape(m.shape + (1,) * (self.data.ndim - 2))
+        return jnp.where(m, self.data, jnp.asarray(fill, self.data.dtype))
+
+    def total_tokens(self) -> jax.Array:
+        return jnp.sum(self.length)
+
+    def with_data(self, data: jax.Array) -> "SequenceBatch":
+        return SequenceBatch(data=data, length=self.length)
+
+    def last_valid(self) -> jax.Array:
+        """[B, ...] value at position length-1 of each sequence."""
+        idx = jnp.maximum(self.length - 1, 0)
+        return jnp.take_along_axis(
+            self.data, idx.reshape(-1, 1, *(1,) * (self.data.ndim - 2)), axis=1
+        ).squeeze(1)
+
+    def first_valid(self) -> jax.Array:
+        return self.data[:, 0]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NestedSequenceBatch:
+    """Padded nested sequences (LoD level 2).
+
+    data:       [B, S, T, ...]
+    num_subseq: [B]    int32 — valid subsequences per sequence.
+    sub_length: [B, S] int32 — valid tokens per subsequence.
+    """
+
+    data: jax.Array
+    num_subseq: jax.Array
+    sub_length: jax.Array
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    def subseq_mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, S] valid-subsequence mask."""
+        s = jnp.arange(self.data.shape[1], dtype=jnp.int32)
+        return (s[None, :] < self.num_subseq[:, None]).astype(dtype)
+
+    def token_mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, S, T] valid-token mask."""
+        t = jnp.arange(self.data.shape[2], dtype=jnp.int32)
+        tok = (t[None, None, :] < self.sub_length[:, :, None]).astype(dtype)
+        return tok * self.subseq_mask(dtype)[:, :, None]
+
+    def flatten_to_subseq(self) -> SequenceBatch:
+        """View the inner level as a flat [B*S, T, ...] SequenceBatch."""
+        b, s = self.data.shape[:2]
+        data = self.data.reshape((b * s,) + self.data.shape[2:])
+        length = (self.sub_length * self.subseq_mask(jnp.int32)).reshape(b * s)
+        return SequenceBatch(data=data, length=length)
+
+    def outer(self) -> SequenceBatch:
+        """The outer level as a sequence of subsequence-slots."""
+        return SequenceBatch(data=self.data, length=self.num_subseq)
+
+
+SeqOrArray = Union[jax.Array, SequenceBatch, NestedSequenceBatch]
+
+
+def value_of(x: SeqOrArray) -> jax.Array:
+    return x.data if isinstance(x, (SequenceBatch, NestedSequenceBatch)) else x
+
+
+def like(template: SeqOrArray, data: jax.Array) -> SeqOrArray:
+    """Re-wrap ``data`` with the sequence metadata of ``template``."""
+    if isinstance(template, SequenceBatch):
+        return SequenceBatch(data=data, length=template.length)
+    if isinstance(template, NestedSequenceBatch):
+        return NestedSequenceBatch(
+            data=data,
+            num_subseq=template.num_subseq,
+            sub_length=template.sub_length,
+        )
+    return data
+
+
+# ---------------------------------------------------------------- host side
+
+def bucket_length(n: int, buckets: Optional[Sequence[int]] = None,
+                  multiple: int = 8) -> int:
+    """Round a max-length up to a bucket to bound recompilation count."""
+    if buckets:
+        for b in sorted(buckets):
+            if n <= b:
+                return b
+        return max(buckets)
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def pad_batch(seqs: Sequence[np.ndarray], max_len: Optional[int] = None,
+              dtype=None, pad_value: float = 0,
+              buckets: Optional[Sequence[int]] = None) -> SequenceBatch:
+    """Pad a ragged list of [t_i, ...] arrays into a SequenceBatch."""
+    seqs = [np.asarray(s) for s in seqs]
+    lengths = np.array([s.shape[0] for s in seqs], dtype=np.int32)
+    t = max_len or bucket_length(int(lengths.max(initial=1)), buckets)
+    trailing = seqs[0].shape[1:] if seqs else ()
+    dtype = dtype or (seqs[0].dtype if seqs else np.float32)
+    out = np.full((len(seqs), t) + trailing, pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        n = min(s.shape[0], t)
+        out[i, :n] = s[:n]
+    return SequenceBatch(data=jnp.asarray(out),
+                         length=jnp.asarray(np.minimum(lengths, t)))
+
+
+def pad_nested_batch(seqs: Sequence[Sequence[np.ndarray]],
+                     max_sub: Optional[int] = None,
+                     max_len: Optional[int] = None,
+                     dtype=None, pad_value: float = 0) -> NestedSequenceBatch:
+    """Pad list-of-list-of-arrays into a NestedSequenceBatch."""
+    b = len(seqs)
+    num_sub = np.array([len(s) for s in seqs], dtype=np.int32)
+    s_dim = max_sub or max(1, int(num_sub.max(initial=1)))
+    all_sub = [np.asarray(x) for seq in seqs for x in seq]
+    t_dim = max_len or bucket_length(
+        max((x.shape[0] for x in all_sub), default=1))
+    trailing = all_sub[0].shape[1:] if all_sub else ()
+    dtype = dtype or (all_sub[0].dtype if all_sub else np.float32)
+    data = np.full((b, s_dim, t_dim) + trailing, pad_value, dtype=dtype)
+    sub_len = np.zeros((b, s_dim), dtype=np.int32)
+    for i, seq in enumerate(seqs):
+        for j, x in enumerate(seq[:s_dim]):
+            x = np.asarray(x)
+            n = min(x.shape[0], t_dim)
+            data[i, j, :n] = x[:n]
+            sub_len[i, j] = n
+    return NestedSequenceBatch(
+        data=jnp.asarray(data),
+        num_subseq=jnp.asarray(np.minimum(num_sub, s_dim)),
+        sub_length=jnp.asarray(sub_len),
+    )
+
+
+def lod_to_lengths(offsets: Sequence[int]) -> np.ndarray:
+    """Reference start-offset vector [0, n1, n1+n2, ...] → lengths."""
+    offs = np.asarray(offsets, dtype=np.int64)
+    enforce(offs.ndim == 1 and offs[0] == 0, "LoD offsets must start at 0")
+    return np.diff(offs).astype(np.int32)
+
+
+def lengths_to_lod(lengths: Sequence[int]) -> np.ndarray:
+    """Lengths → reference start-offset vector (Argument.h convention)."""
+    return np.concatenate(
+        [[0], np.cumsum(np.asarray(lengths, dtype=np.int64))]
+    )
+
+
+def flat_to_padded(flat: np.ndarray, offsets: Sequence[int],
+                   max_len: Optional[int] = None) -> SequenceBatch:
+    """Reference flat-matrix+offsets layout → padded SequenceBatch."""
+    lengths = lod_to_lengths(offsets)
+    seqs = [flat[offsets[i]:offsets[i + 1]] for i in range(len(lengths))]
+    return pad_batch(seqs, max_len=max_len)
+
+
+def padded_to_flat(batch: SequenceBatch) -> Tuple[np.ndarray, np.ndarray]:
+    """Padded SequenceBatch → (flat matrix, offsets) on host."""
+    data = np.asarray(batch.data)
+    lengths = np.asarray(batch.length)
+    flat = np.concatenate([data[i, : lengths[i]] for i in range(len(lengths))]
+                          ) if len(lengths) else data.reshape((0,) + data.shape[2:])
+    return flat, lengths_to_lod(lengths)

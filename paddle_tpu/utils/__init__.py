@@ -1,0 +1,19 @@
+from .error import ConfigError, PaddleTpuError, ShapeError, enforce, enforce_eq, layer_stack
+from .flags import FLAGS
+from .logger import get_logger
+from .registry import Registry
+from .stat import StatSet, global_stat
+
+__all__ = [
+    "ConfigError",
+    "PaddleTpuError",
+    "ShapeError",
+    "enforce",
+    "enforce_eq",
+    "layer_stack",
+    "FLAGS",
+    "get_logger",
+    "Registry",
+    "StatSet",
+    "global_stat",
+]

@@ -1,0 +1,69 @@
+"""Error types and enforce helpers.
+
+Equivalent of the reference's ``paddle/utils/Error.h`` and the next-gen
+``PADDLE_ENFORCE*`` macros (``paddle/platform/enforce.h``).  Python exceptions
+replace status codes; ``enforce`` gives the same "check with formatted
+message" ergonomics and ``layer_stack`` mirrors ``CustomStackTrace`` —
+the per-thread stack of layer names dumped when a forward/backward fails
+(``paddle/utils/CustomStackTrace.h:51``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator, List
+
+
+class PaddleTpuError(RuntimeError):
+    """Base error for the framework."""
+
+
+class ShapeError(PaddleTpuError):
+    """Tensor shape/rank mismatch."""
+
+
+class ConfigError(PaddleTpuError):
+    """Bad model/trainer configuration."""
+
+
+def enforce(cond: Any, msg: str = "", *args: Any) -> None:
+    if not cond:
+        text = msg % args if args else msg
+        stack = layer_stack.current()
+        if stack:
+            text += f"\n  while executing layer stack: {' -> '.join(stack)}"
+        raise PaddleTpuError(text)
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    enforce(a == b, f"{msg} (got {a!r} != {b!r})" if msg else f"{a!r} != {b!r}")
+
+
+class _LayerStack(threading.local):
+    """Per-thread stack of layer names for error context."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+
+    def current(self) -> List[str]:
+        return list(self.stack)
+
+    @contextlib.contextmanager
+    def guard(self, name: str) -> Iterator[None]:
+        self.stack.append(name)
+        try:
+            yield
+        except Exception as e:
+            if not getattr(e, "_pt_stack_noted", False):
+                e._pt_stack_noted = True  # type: ignore[attr-defined]
+                e.args = (
+                    (e.args[0] if e.args else "")
+                    + f"\n  [layer stack: {' -> '.join(self.stack)}]",
+                ) + tuple(e.args[1:])
+            raise
+        finally:
+            self.stack.pop()
+
+
+layer_stack = _LayerStack()

@@ -1,0 +1,123 @@
+"""Central runtime-flag registry.
+
+Equivalent of the reference's gflags hub (``paddle/utils/Flags.cpp:18-84``):
+one process-wide table of named knobs, settable from the CLI
+(``--name=value``), the environment (``PADDLE_TPU_<NAME>``), or code.  The
+reference defines 109 flags; we keep the ones that still mean something on
+TPU (device selection is a mesh, not ``--gpu_id``) and add TPU-specific ones.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+class FlagRegistry:
+    def __init__(self) -> None:
+        self._specs: Dict[str, _FlagSpec] = {}
+        self._values: Dict[str, Any] = {}
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        if isinstance(default, bool):
+            parser: Callable[[str], Any] = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+        self._specs[name] = _FlagSpec(name, default, help, parser)
+        env = os.environ.get("PADDLE_TPU_" + name.upper())
+        self._values[name] = parser(env) if env is not None else default
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(f"unknown flag {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._specs:
+            raise KeyError(f"unknown flag {name!r}")
+        self._values[name] = value
+
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+    def parse_argv(self, argv: List[str]) -> List[str]:
+        """Consume ``--name=value`` / ``--name value`` args; return the rest."""
+        rest: List[str] = []
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            if arg.startswith("--"):
+                body = arg[2:]
+                if "=" in body:
+                    name, val = body.split("=", 1)
+                else:
+                    name = body
+                    if (
+                        name in self._specs
+                        and not isinstance(self._specs[name].default, bool)
+                        and i + 1 < len(argv)
+                    ):
+                        i += 1
+                        val = argv[i]
+                    else:
+                        val = "true"
+                name = name.replace("-", "_")
+                if name in self._specs:
+                    self._values[name] = self._specs[name].parser(val)
+                else:
+                    rest.append(arg)
+            else:
+                rest.append(arg)
+            i += 1
+        return rest
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+FLAGS = FlagRegistry()
+
+# Core knobs (reference: paddle/utils/Flags.cpp).
+FLAGS.define("use_tpu", True, "run compute on the TPU backend (else CPU)")
+FLAGS.define("trainer_count", 1, "data-parallel replicas (mesh 'data' axis size)")
+FLAGS.define("trainer_id", 0, "index of this host in a multi-host job")
+FLAGS.define("num_hosts", 1, "number of hosts in the job")
+FLAGS.define("log_period", 100, "log every N batches")
+FLAGS.define("test_period", 0, "test every N batches (0: per pass)")
+FLAGS.define("show_parameter_stats_period", 0, "dump param stats every N batches")
+FLAGS.define("checkgrad_eps", 1e-2, "finite-difference step for --job=checkgrad")
+FLAGS.define("seed", 1, "global RNG seed (0: nondeterministic)")
+FLAGS.define("dot_period", 1, "print a progress dot every N batches")
+FLAGS.define("saving_period", 1, "checkpoint every N passes")
+FLAGS.define("load_missing_parameter_strategy", "fail", "fail|rand|zero")
+FLAGS.define("init_model_path", "", "checkpoint dir to warm-start from")
+FLAGS.define("start_pass", 0, "first pass number (resume)")
+FLAGS.define("save_dir", "./output", "checkpoint output dir")
+FLAGS.define("config_args", "", "comma-sep k=v pairs visible to configs")
+FLAGS.define("use_bf16", True, "run matmul/conv compute in bfloat16 on TPU")
+FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
+FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
+FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")
+FLAGS.define("enable_timers", True, "collect named wall timers (Stat.h equivalent)")
+FLAGS.define("port", 7164, "data-task coordinator service port")
+FLAGS.define("ports_num", 1, "kept for config compatibility; unused on TPU")
+FLAGS.define("num_gradient_servers", 1, "kept for config compatibility")
+FLAGS.define("rdma_tcp", "tcp", "kept for config compatibility; unused on TPU")

@@ -1,0 +1,21 @@
+"""Logging setup (glog-equivalent: ``paddle/utils/Logging.h``)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FMT = "%(levelname).1s %(asctime)s.%(msecs)03d %(name)s] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_root = logging.getLogger("paddle_tpu")
+if not _root.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(_FMT, _DATEFMT))
+    _root.addHandler(h)
+    _root.setLevel(logging.INFO)
+    _root.propagate = False
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return _root.getChild(name) if name else _root

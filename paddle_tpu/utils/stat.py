@@ -1,0 +1,91 @@
+"""Named wall-clock timers with aggregated stats.
+
+Equivalent of the reference's ``StatSet``/``REGISTER_TIMER`` RAII timers
+(``paddle/utils/Stat.h:63-242``): every scope accumulates count/total/max
+under a name, and ``print_all_status`` dumps the table.  The trainer wraps
+each layer's forward/backward in one of these, exactly like
+``NeuralNetwork.cpp:258,298``.
+
+On TPU the async dispatch model means a timer around a jitted call measures
+dispatch unless the value is blocked on; ``timer(..., block_on=x)`` calls
+``x.block_until_ready()`` before stopping the clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Optional
+
+
+@dataclass
+class StatItem:
+    name: str
+    count: int = 0
+    total: float = 0.0
+    max: float = 0.0
+    min: float = float("inf")
+
+    def add(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.max = max(self.max, seconds)
+        self.min = min(self.min, seconds)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self, name: str = "global"):
+        self.name = name
+        self._items: Dict[str, StatItem] = {}
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def item(self, name: str) -> StatItem:
+        with self._lock:
+            if name not in self._items:
+                self._items[name] = StatItem(name)
+            return self._items[name]
+
+    @contextlib.contextmanager
+    def timer(self, name: str, block_on: Any = None) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(block_on)
+                except Exception:
+                    pass
+            self.item(name).add(time.perf_counter() - t0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+    def print_all_status(self, log=print) -> None:
+        with self._lock:
+            items = sorted(self._items.values(), key=lambda i: -i.total)
+        if not items:
+            return
+        log(f"======= StatSet: [{self.name}] status ======")
+        log(f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>10} {'max(ms)':>10}")
+        for it in items:
+            log(
+                f"{it.name:<40} {it.count:>8} {it.total * 1e3:>12.2f} "
+                f"{it.avg * 1e3:>10.3f} {it.max * 1e3:>10.3f}"
+            )
+
+
+global_stat = StatSet()

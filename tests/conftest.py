@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Mirrors the reference's CPU-stub trick (``paddle/cuda/include/stub/`` lets the
+whole engine test without CUDA): we force the JAX CPU backend with 8 virtual
+devices so every multi-chip sharding test runs on any machine, no TPU needed.
+Must run before jax initializes a backend, hence the env mutation at import
+time of this conftest.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+# fp32 on CPU — bf16 matmuls are TPU-only territory; tests check numerics.
+os.environ.setdefault("PADDLE_TPU_USE_BF16", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    yield
+    from paddle_tpu.utils.stat import global_stat
+
+    global_stat.reset()

@@ -1,0 +1,109 @@
+"""Core utils/sequence tests (reference: paddle/utils/tests, test_argument.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import sequence as seq
+from paddle_tpu.core.device import build_mesh, DATA_AXIS
+from paddle_tpu.utils import FLAGS, PaddleTpuError, Registry, enforce, global_stat
+
+
+def test_registry_roundtrip():
+    r = Registry("thing")
+
+    @r.register("alpha", "a")
+    class Alpha:
+        pass
+
+    assert r.get("alpha") is Alpha
+    assert r.get("a") is Alpha
+    assert "alpha" in r
+    with pytest.raises(PaddleTpuError):
+        r.get("nope")
+    with pytest.raises(PaddleTpuError):
+        r.register("alpha")(Alpha)
+
+
+def test_flags_parse_argv():
+    rest = FLAGS.parse_argv(["--log_period=5", "positional", "--seed", "7"])
+    assert FLAGS.log_period == 5
+    assert FLAGS.seed == 7
+    assert rest == ["positional"]
+    FLAGS.set("log_period", 100)
+    FLAGS.set("seed", 1)
+
+
+def test_enforce_message():
+    with pytest.raises(PaddleTpuError, match="bad dim"):
+        enforce(False, "bad dim %d", 3)
+
+
+def test_stat_timer():
+    with global_stat.timer("unit"):
+        pass
+    assert global_stat.item("unit").count == 1
+
+
+def test_lod_roundtrip():
+    offs = [0, 3, 3, 7]
+    lens = seq.lod_to_lengths(offs)
+    np.testing.assert_array_equal(lens, [3, 0, 4])
+    np.testing.assert_array_equal(seq.lengths_to_lod(lens), offs)
+
+
+def test_pad_batch_and_mask():
+    data = [np.ones((2, 4)), np.ones((5, 4)) * 2, np.ones((1, 4)) * 3]
+    sb = seq.pad_batch(data)
+    assert sb.data.shape[0] == 3
+    assert sb.max_len >= 5
+    np.testing.assert_array_equal(np.asarray(sb.length), [2, 5, 1])
+    m = np.asarray(sb.mask())
+    assert m.sum() == 8
+    # masked_data zeroes padding
+    md = np.asarray(sb.masked_data())
+    assert md[0, 2:].sum() == 0
+    np.testing.assert_allclose(np.asarray(sb.last_valid())[1], 2 * np.ones(4))
+
+
+def test_flat_padded_roundtrip():
+    flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+    offs = [0, 2, 6]
+    sb = seq.flat_to_padded(flat, offs)
+    flat2, offs2 = seq.padded_to_flat(sb)
+    np.testing.assert_array_equal(flat2, flat)
+    np.testing.assert_array_equal(offs2, offs)
+
+
+def test_nested_batch():
+    seqs = [
+        [np.ones((2, 3)), np.ones((4, 3))],
+        [np.ones((1, 3))],
+    ]
+    nb = seq.pad_nested_batch(seqs)
+    np.testing.assert_array_equal(np.asarray(nb.num_subseq), [2, 1])
+    tm = np.asarray(nb.token_mask())
+    assert tm.sum() == 7
+    flat = nb.flatten_to_subseq()
+    np.testing.assert_array_equal(np.asarray(flat.length), [2, 4, 1, 0])
+
+
+def test_mesh_virtual_8():
+    assert len(jax.devices()) == 8
+    mesh = build_mesh({DATA_AXIS: 8})
+    assert mesh.shape[DATA_AXIS] == 8
+    mesh2 = build_mesh({"data": 4, "model": 2})
+    assert mesh2.shape["model"] == 2
+
+
+def test_sequence_batch_is_pytree():
+    sb = seq.pad_batch([np.ones((2, 3))])
+    leaves = jax.tree_util.tree_leaves(sb)
+    assert len(leaves) == 2
+
+    @jax.jit
+    def f(s):
+        return s.with_data(s.data * 2).total_tokens()
+
+    assert int(f(sb)) == 2
