@@ -16,6 +16,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # fp32 on CPU — bf16 matmuls are TPU-only territory; tests check numerics.
 os.environ.setdefault("PADDLE_TPU_USE_BF16", "0")
 
+import jax
+
+# sitecustomize may have imported jax already (latching JAX_PLATFORMS=axon
+# into jax.config), so update the config directly too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import numpy as np
 import pytest
 
