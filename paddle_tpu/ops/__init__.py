@@ -1,0 +1,20 @@
+"""Functional op library (pure jax; the framework's kernel layer).
+
+Importing this package populates the op registry with the full inventory
+(SURVEY §2.2 appendix + gserver layer math).
+"""
+
+from . import (  # noqa: F401  (import for registration side effects)
+    activations,
+    crf_ops,
+    embedding_ops,
+    loss_ops,
+    math_ops,
+    nn_ops,
+    recurrent_ops,
+    sequence_ops,
+)
+from .activations import ACTIVATIONS, get_activation
+from .registry import OPS, get_op, register_op
+
+__all__ = ["ACTIVATIONS", "OPS", "get_activation", "get_op", "register_op"]

@@ -1,0 +1,193 @@
+"""Activation functions.
+
+Covers the union of the reference's activation surfaces: the gserver
+activation registry (``paddle/gserver/activations/ActivationFunction.cpp`` —
+sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs,
+square, exponential, reciprocal, sqrt, log) and the next-gen activation op
+family (``paddle/operators/activation_op.cc`` — 24 ops).  All are elementwise
+jax functions that XLA fuses into their producers on TPU; no Pallas needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import Registry
+from .registry import register_op
+
+ACTIVATIONS: Registry = Registry("activation")
+
+
+def _act(name: str, *aliases: str):
+    def deco(fn):
+        ACTIVATIONS.register_value(name, fn, *aliases)
+        register_op(name)(fn)
+        return fn
+
+    return deco
+
+
+@_act("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_act("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@_act("exp", "exponential")
+def exp(x):
+    return jnp.exp(x)
+
+
+@_act("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@_act("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@_act("tanh_shrink")
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+@_act("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@_act("abs")
+def abs_(x):
+    return jnp.abs(x)
+
+
+@_act("reciprocal")
+def reciprocal(x):
+    return 1.0 / x
+
+
+@_act("log")
+def log(x):
+    return jnp.log(x)
+
+
+@_act("square")
+def square(x):
+    return jnp.square(x)
+
+
+@_act("brelu")
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    return jnp.clip(x, t_min, t_max)
+
+
+@_act("soft_relu", "softrelu")
+def soft_relu(x, threshold: float = 40.0):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+@_act("pow")
+def pow_(x, factor: float = 1.0):
+    return jnp.power(x, factor)
+
+
+@_act("stanh")
+def stanh(x, scale_a: float = 2.0 / 3.0, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@_act("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@_act("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@_act("leaky_relu")
+def leaky_relu(x, alpha: float = 0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@_act("elu")
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@_act("relu6")
+def relu6(x, threshold: float = 6.0):
+    return jnp.clip(x, 0.0, threshold)
+
+
+@_act("hard_shrink")
+def hard_shrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@_act("softshrink")
+def softshrink(x, lambda_: float = 0.5):
+    return jnp.where(x > lambda_, x - lambda_, jnp.where(x < -lambda_, x + lambda_, 0.0))
+
+
+@_act("thresholded_relu")
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@_act("hard_sigmoid")
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@_act("swish")
+def swish(x, beta: float = 1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@_act("linear", "identity", "")
+def linear(x):
+    return x
+
+
+@_act("softmax")
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@_act("sequence_softmax")
+def sequence_softmax(x, mask=None):
+    """Softmax over the time axis of a padded [B, T] (or [B, T, 1]) batch.
+
+    Reference computes softmax per variable-length sequence
+    (``SequenceSoftmaxActivation``); here padding positions are masked to
+    -inf so they get zero probability.
+    """
+    squeeze = False
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+        squeeze = True
+    if mask is not None:
+        x = jnp.where(mask > 0, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=-1)
+    if mask is not None:
+        out = jnp.where(mask > 0, out, 0.0)
+    if squeeze:
+        out = out[..., None]
+    return out
+
+
+def get_activation(name: Optional[str]):
+    if name is None:
+        return linear
+    return ACTIVATIONS.get(name)

@@ -1,0 +1,142 @@
+"""Linear-chain CRF and CTC ops.
+
+Replaces ``LinearChainCRF`` (+ ``CRFLayer``, ``CRFDecodingLayer``),
+``linear_chain_crf_op.cc``, and the warp-ctc wrapper (``WarpCTCLayer``,
+``hl_warpctc_wrap.cc``, ``LinearChainCTC``).
+
+TPU-first: forward algorithm and Viterbi are ``lax.scan`` over time on the
+padded layout with log-space arithmetic (reference works per-sequence on CPU
+with explicit loops).  CTC uses optax's XLA-native implementation instead of
+an external warp-ctc binary.
+
+Transition-parameter layout follows the reference (``LinearChainCRF.cpp``):
+``w[0] = a`` (start), ``w[1] = b`` (end), ``w[2:] = T[tag_from, tag_to]``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.sequence import SequenceBatch
+from .registry import register_op
+
+
+def _split_w(w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    return w[0], w[1], w[2:]
+
+
+@register_op("linear_chain_crf")
+def crf_nll(emissions: SequenceBatch, labels: SequenceBatch, w: jax.Array
+            ) -> jax.Array:
+    """Negative log-likelihood per sequence (``CRFLayer::forward``).
+
+    emissions.data: [B, T, N] unnormalized scores; labels.data: [B, T] int;
+    w: [N+2, N] (start row, end row, transitions).
+    """
+    a, b, trans = _split_w(w)
+    x = emissions.data.astype(jnp.float32)
+    ids = labels.data.astype(jnp.int32)
+    mask = emissions.mask(jnp.float32)  # [B, T]
+    B, T, N = x.shape
+    if ids.shape[1] < T:  # label buffer may be bucketed shorter
+        ids = jnp.pad(ids, [(0, 0), (0, T - ids.shape[1])])
+    else:
+        ids = ids[:, :T]
+
+    # --- log partition via forward algorithm
+    alpha0 = a[None, :] + x[:, 0]  # [B, N]
+
+    def fwd(alpha, inp):
+        x_t, m_t = inp
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, from, to]
+        new = jax.nn.logsumexp(scores, axis=1) + x_t
+        m = m_t[:, None]
+        return m * new + (1 - m) * alpha, None
+
+    alpha, _ = lax.scan(
+        fwd, alpha0,
+        (jnp.moveaxis(x[:, 1:], 1, 0), jnp.moveaxis(mask[:, 1:], 1, 0)))
+    logz = jax.nn.logsumexp(alpha + b[None, :], axis=-1)
+
+    # --- gold path score
+    first_emit = jnp.take_along_axis(x[:, 0], ids[:, :1], axis=-1)[:, 0]
+    gold = a[ids[:, 0]] + first_emit
+
+    def gold_step(carry, inp):
+        score, prev = carry
+        x_t, y_t, m_t = inp
+        emit = jnp.take_along_axis(x_t, y_t[:, None], axis=-1)[:, 0]
+        tr = trans[prev, y_t]
+        score = score + m_t * (emit + tr)
+        prev = jnp.where(m_t > 0, y_t, prev)
+        return (score, prev), None
+
+    (gold, last), _ = lax.scan(
+        gold_step, (gold, ids[:, 0]),
+        (jnp.moveaxis(x[:, 1:], 1, 0), jnp.moveaxis(ids[:, 1:], 1, 0),
+         jnp.moveaxis(mask[:, 1:], 1, 0)))
+    gold = gold + b[last]
+    return logz - gold
+
+
+@register_op("crf_decoding")
+def crf_decode(emissions: SequenceBatch, w: jax.Array) -> SequenceBatch:
+    """Viterbi decode (``CRFDecodingLayer`` / ``LinearChainCRF::decode``)
+    → SequenceBatch of int32 best tags [B, T]."""
+    a, b, trans = _split_w(w)
+    x = emissions.data.astype(jnp.float32)
+    mask = emissions.mask(jnp.float32)
+    B, T, N = x.shape
+    alpha0 = a[None, :] + x[:, 0]
+
+    def vit(alpha, inp):
+        x_t, m_t = inp
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new = jnp.max(scores, axis=1) + x_t
+        m = m_t[:, None]
+        alpha_new = m * new + (1 - m) * alpha
+        # for masked steps backpointer is identity
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+        bp = jnp.where(m_t[:, None] > 0, best_prev, ident)
+        return alpha_new, bp
+
+    alpha, bps = lax.scan(
+        vit, alpha0,
+        (jnp.moveaxis(x[:, 1:], 1, 0), jnp.moveaxis(mask[:, 1:], 1, 0)))
+    last_tag = jnp.argmax(alpha + b[None, :], axis=-1)  # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=-1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = lax.scan(back, last_tag, bps[::-1])
+    # tags_rev = [tag_{T-1} ... tag_1]; the final carry is tag_0
+    tags = jnp.concatenate(
+        [first_tag[:, None], tags_rev[::-1].transpose(1, 0)], axis=1)  # [B, T]
+    return SequenceBatch(data=tags.astype(jnp.int32), length=emissions.length)
+
+
+@register_op("warpctc", "ctc")
+def ctc_loss(logits: SequenceBatch, labels: SequenceBatch,
+             blank: int = 0, norm_by_times: bool = False) -> jax.Array:
+    """CTC loss per sequence (``WarpCTCLayer``/``CTCLayer``).
+
+    logits.data: [B, T, C] unnormalized; labels.data: [B, L] int.
+    Uses optax's XLA-native CTC (log-semiring dynamic program) — the
+    TPU replacement for the warp-ctc CUDA dependency.
+    """
+    import optax
+
+    logit_pad = 1.0 - logits.mask(jnp.float32)
+    label_pad = 1.0 - labels.mask(jnp.float32)
+    per_seq = optax.ctc_loss(
+        logits.data.astype(jnp.float32), logit_pad,
+        labels.data.astype(jnp.int32), label_pad, blank_id=blank)
+    if norm_by_times:
+        per_seq = per_seq / jnp.maximum(logits.length.astype(jnp.float32), 1.0)
+    return per_seq

@@ -1,0 +1,119 @@
+"""Embedding / sparse-parameter ops.
+
+Replaces ``lookup_table_op`` (+ its SelectedRows sparse gradient),
+``TableProjection``, ``NCELayer`` (+ ``MultinomialSampler``),
+``HierarchicalSigmoidLayer`` (+ ``MatrixBitCode``), ``SelectiveFullyConnectedLayer``.
+
+TPU-first: lookups are one-hot-free ``take`` gathers; sparse gradients are
+expressed as dense-shaped scatter-adds (XLA turns them into efficient
+dynamic-update-slices) or, for sharded giant tables, the fixed-capacity
+row-gather in :mod:`paddle_tpu.parallel.sparse`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .math_ops import matmul
+from .registry import register_op
+
+
+@register_op("lookup_table", "embedding")
+def lookup_table(table: jax.Array, ids: jax.Array,
+                 padding_idx: Optional[int] = None) -> jax.Array:
+    """table [V, D], ids [...] int → [..., D]."""
+    out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+@register_op("nce")
+def nce_loss(x, labels, w, b, sample_ids, sample_probs,
+             num_true: int = 1) -> jax.Array:
+    """Noise-contrastive estimation cost (``NCELayer``).
+
+    x: [B, D]; labels: [B] int; w: [V, D]; b: [V];
+    sample_ids: [B, S] pre-drawn negative ids; sample_probs: [B, S] their
+    noise probabilities (the reference samples from a multinomial over word
+    frequency — sampling happens host-side / with jax.random upstream).
+    """
+    def logits_for(ids):
+        wi = jnp.take(w, ids, axis=0)  # [B, K, D]
+        bi = jnp.take(b, ids, axis=0)  # [B, K]
+        return jnp.einsum("bd,bkd->bk", x, wi) + bi
+
+    pos = logits_for(labels.reshape(-1, 1).astype(jnp.int32))  # [B, 1]
+    neg = logits_for(sample_ids.astype(jnp.int32))  # [B, S]
+    # P(true) = sigmoid(logit); NCE binary CE against 1 for true, 0 for noise
+    pos_loss = jnp.maximum(pos, 0) - pos + jnp.log1p(jnp.exp(-jnp.abs(pos)))
+    neg_loss = jnp.maximum(neg, 0) + jnp.log1p(jnp.exp(-jnp.abs(neg)))
+    return pos_loss[:, 0] + jnp.sum(neg_loss, axis=-1)
+
+
+def _bit_codes(labels: jax.Array, num_classes: int, depth: int
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference bit-code scheme (``MatrixBitCode.cpp``): code(c) = c +
+    num_classes, walking /2 until 1; node index = code/2 - 1, bit = code&1."""
+    code = labels.astype(jnp.int32) + num_classes
+    nodes, bits, valid = [], [], []
+    for _ in range(depth):
+        nodes.append(code // 2 - 1)
+        bits.append(code & 1)
+        valid.append(code > 1)
+        code = code // 2
+    return (jnp.stack(nodes, -1), jnp.stack(bits, -1),
+            jnp.stack(valid, -1))
+
+
+@register_op("hsigmoid")
+def hierarchical_sigmoid(x, labels, w, bias, num_classes: int) -> jax.Array:
+    """Hierarchical sigmoid cost (``HierarchicalSigmoidLayer``).
+
+    x: [B, D]; w: [num_classes-1, D]; bias: [num_classes-1].
+    Cost = sum over the label's tree path of binary CE at each inner node.
+    """
+    depth = max(1, int(num_classes - 1).bit_length())
+    nodes, bits, valid = _bit_codes(labels, num_classes, depth)
+    nodes = jnp.clip(nodes, 0, w.shape[0] - 1)
+    wn = jnp.take(w, nodes, axis=0)  # [B, depth, D]
+    bn = jnp.take(bias, nodes, axis=0)  # [B, depth]
+    logits = jnp.einsum("bd,btd->bt", x, wn) + bn
+    # bit==1 → target 1 (reference: pred = sigmoid(sum), cost −log pred for
+    # one-bits, −log(1−pred) for zero-bits)
+    tgt = bits.astype(logits.dtype)
+    ce = jnp.maximum(logits, 0) - logits * tgt + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(jnp.where(valid, ce, 0.0), axis=-1)
+
+
+@register_op("selective_fc")
+def selective_fc(x, w, bias, select_ids: Optional[jax.Array] = None,
+                 act: str = "softmax"):
+    """Selective fully-connected (``SelectiveFullyConnectedLayer``): compute
+    output columns only for ``select_ids`` [B, K]; others are 0/-inf.
+
+    w: [D, V] full table.  With select_ids None it's a plain FC.
+    """
+    from .activations import get_activation
+
+    if select_ids is None:
+        out = matmul(x, w)
+        if bias is not None:
+            out = out + bias
+        return get_activation(act)(out)
+    wk = jnp.take(w, select_ids.astype(jnp.int32), axis=1)  # [D, B, K] -> careful
+    wk = jnp.moveaxis(wk, 1, 0)  # [B, D, K]
+    out = jnp.einsum("bd,bdk->bk", x, wk)
+    if bias is not None:
+        out = out + jnp.take(bias, select_ids.astype(jnp.int32), axis=0)
+    return get_activation(act)(out)
+
+
+@register_op("sampling_id")
+def sampling_id(key, probs: jax.Array) -> jax.Array:
+    """Sample one id per row from a probability matrix (``SamplingIdLayer``)."""
+    return jax.random.categorical(key, jnp.log(jnp.clip(probs, 1e-20, 1.0)),
+                                  axis=-1).astype(jnp.int32)

@@ -1,0 +1,164 @@
+"""Loss / cost ops.
+
+Union of the reference's cost layers (``paddle/gserver/layers/CostLayer.cpp``:
+cross-entropy, multi-class CE + selfnorm, huber, rank, lambda-rank, smooth-l1,
+sum-of-squares, multi-binary-label CE) and loss ops
+(``paddle/operators/cross_entropy_op.cc``, ``softmax_with_cross_entropy``,
+``sigmoid_cross_entropy_with_logits``, ``smooth_l1_loss``, ``huber_loss``,
+``modified_huber_loss``, ``rank_loss``, ``margin_rank_loss``,
+``squared_l2_distance``, ``squared_l2_norm``, ``l1_norm``).
+
+All return **per-example** losses [B] (or [B,1]); reduction to scalar cost is
+the trainer's job (matching ``Argument::sum`` over the cost layer output).
+Numerically-stable log-softmax formulations are used instead of the
+reference's explicit softmax-then-log, for bf16 safety on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("cross_entropy")
+def cross_entropy(p, label, soft_label: bool = False, eps: float = 1e-8):
+    """CE on probabilities (reference ``cross_entropy_op`` semantics).
+
+    p: [B, C] probabilities; label: [B] int ids or [B, C] soft labels.
+    """
+    logp = jnp.log(jnp.clip(p, eps, 1.0))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=-1)
+    return -jnp.take_along_axis(logp, label.reshape(-1, 1).astype(jnp.int32), axis=-1)[:, 0]
+
+
+@register_op("softmax_with_cross_entropy", "classification_cost")
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False):
+    """Fused stable log-softmax CE (``softmax_with_cross_entropy_op.cc``)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=-1)
+    return -jnp.take_along_axis(logp, label.reshape(-1, 1).astype(jnp.int32), axis=-1)[:, 0]
+
+
+@register_op("multi_binary_label_cross_entropy")
+def multi_binary_label_cross_entropy(p, labels, eps: float = 1e-8):
+    """CE with multiple binary labels per example (``CostLayer.cpp``
+    MultiBinaryLabelCrossEntropy): labels is dense [B, C] 0/1."""
+    p = jnp.clip(p, eps, 1.0 - eps)
+    return -jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p), axis=-1)
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(x, label):
+    """Stable elementwise sigmoid CE (``sigmoid_cross_entropy_with_logits_op``)."""
+    return jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("square_error", "sum_of_squares", "mse_cost")
+def square_error(x, label):
+    """Sum-of-squares cost (``SumOfSquaresCostLayer``): 0.5 * ||x - y||^2."""
+    d = x - label
+    return 0.5 * jnp.sum(jnp.square(d).reshape(d.shape[0], -1), axis=-1)
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(x, y):
+    d = (x - y).reshape(x.shape[0], -1)
+    return jnp.sum(jnp.square(d), axis=-1)
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+@register_op("l1_norm")
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+@register_op("smooth_l1_loss", "smooth_l1")
+def smooth_l1_loss(x, y, sigma: float = 1.0):
+    """Smooth-L1 (``smooth_l1_loss_op.cc``): sigma-scaled Huber, summed per row."""
+    s2 = sigma * sigma
+    d = jnp.abs(x - y)
+    per = jnp.where(d < 1.0 / s2, 0.5 * s2 * jnp.square(d), d - 0.5 / s2)
+    return jnp.sum(per.reshape(per.shape[0], -1), axis=-1)
+
+
+@register_op("huber_loss", "huber_regression_cost")
+def huber_loss(x, y, delta: float = 1.0):
+    d = jnp.abs(y - x)
+    per = jnp.where(d <= delta, 0.5 * jnp.square(d), delta * (d - 0.5 * delta))
+    return jnp.sum(per.reshape(per.shape[0], -1), axis=-1)
+
+
+@register_op("huber_classification_cost")
+def huber_classification_cost(x, label):
+    """Huber two-class cost (``HuberTwoClassification``): labels {0,1}→{-1,1}."""
+    y = 2.0 * label.reshape(-1).astype(x.dtype) - 1.0
+    a = x.reshape(-1) * y
+    return jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(x, label):
+    y = 2.0 * label.reshape(-1).astype(x.dtype) - 1.0
+    a = x.reshape(-1) * y
+    return jnp.where(a < -1.0, -4.0 * a, jnp.square(jnp.maximum(0.0, 1.0 - a)))
+
+
+@register_op("rank_loss", "rank_cost")
+def rank_loss(left, right, label):
+    """Pairwise rank cost (``RankingCost``, ``rank_loss_op.cc``):
+    CE of sigmoid(left-right) against label in [0,1]."""
+    o = (left - right).reshape(-1)
+    lab = label.reshape(-1).astype(o.dtype)
+    return jnp.maximum(o, 0) - o * lab + jnp.log1p(jnp.exp(-jnp.abs(o)))
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(x1, x2, label, margin: float = 0.0):
+    """max(0, -label*(x1-x2) + margin) (``margin_rank_loss_op.cc``)."""
+    return jnp.maximum(
+        0.0, -label.reshape(-1) * (x1 - x2).reshape(-1) + margin)
+
+
+@register_op("lambda_cost")
+def lambda_cost(scores, gains, mask, ndcg_num: int = 5):
+    """LambdaRank cost over one padded query list (``LambdaCost`` layer).
+
+    scores/gains/mask: [B, L] padded lists.  Returns [B] pseudo-cost whose
+    gradient is the NDCG-weighted pairwise lambda, computed per list.
+    """
+    def one_list(s, g, m):
+        valid = m > 0
+        # ideal DCG from top-ndcg_num gains
+        order = jnp.argsort(jnp.where(valid, -g, jnp.inf))
+        sorted_g = g[order]
+        pos = jnp.arange(g.shape[0])
+        disc = 1.0 / jnp.log2(pos + 2.0)
+        take = pos < ndcg_num
+        max_dcg = jnp.sum(jnp.where(take, (2.0 ** sorted_g - 1.0) * disc, 0.0))
+        inv_max = jnp.where(max_dcg > 0, 1.0 / max_dcg, 0.0)
+        sdiff = s[:, None] - s[None, :]
+        pair = (g[:, None] > g[None, :]) & valid[:, None] & valid[None, :]
+        dg = (2.0 ** g[:, None] - 2.0 ** g[None, :]) * inv_max
+        # surrogate whose d/ds matches lambda = |dNDCG| * sigmoid'(sdiff)
+        surrogate = jnp.abs(dg) * jnp.log1p(jnp.exp(-sdiff))
+        return jnp.sum(jnp.where(pair, surrogate, 0.0))
+
+    return jax.vmap(one_list)(scores, gains, mask)
+
+
+@register_op("cross_entropy_over_beam")
+def cross_entropy_over_beam(beam_scores, gold_in_beam_mask):
+    """CE over per-step beam candidates (``CrossEntropyOverBeam`` layer):
+    beam_scores [B, K] candidate scores, gold mask [B, K] one-hot-ish."""
+    logp = jax.nn.log_softmax(beam_scores, axis=-1)
+    return -jnp.sum(gold_in_beam_mask * logp, axis=-1)

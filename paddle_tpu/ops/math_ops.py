@@ -1,0 +1,294 @@
+"""Dense math ops.
+
+The TPU replacement for the reference's hand-rolled linear algebra:
+``paddle/math/Matrix.h`` / ``BaseMatrix`` elementwise+aggregate families,
+``paddle/operators`` math ops (mul, matmul, sum, scale, clip, elementwise_*,
+reduce_*, transpose, reshape, concat, split, pad, crop, cast, gather,
+scatter, top_k, multiplex, …), and ``paddle/function`` Mul/CosSim/Crop/Pad.
+Everything lowers to XLA HLO; matmuls go through :func:`matmul` which applies
+the bf16 compute policy so they hit the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtypes import current_policy
+from .registry import register_op
+
+
+@register_op("matmul", "mul")
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           scale: float = 1.0):
+    """MXU matmul with mixed-precision policy (bf16 in, f32 accumulate).
+
+    Reference: ``paddle/operators/matmul_op.cc`` / ``Matrix::mul``
+    (``paddle/math/Matrix.h``).
+    """
+    pol = current_policy()
+    if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        x = x.astype(pol.compute_dtype)
+        y = y.astype(pol.compute_dtype)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=pol.output_dtype)
+    if scale != 1.0:
+        out = out * scale
+    return out
+
+
+@register_op("sum")
+def sum_arrays(*xs):
+    """Sum N same-shape tensors (``paddle/operators/sum_op.cc``)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("scale")
+def scale(x, scale: float = 1.0, bias: float = 0.0):
+    return x * scale + bias
+
+
+@register_op("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@register_op("clip")
+def clip(x, min: float, max: float):
+    return jnp.clip(x, min, max)
+
+
+@register_op("mean")
+def mean(x):
+    return jnp.mean(x)
+
+
+@register_op("minus")
+def minus(x, y):
+    return x - y
+
+
+@register_op("increment")
+def increment(x, step: float = 1.0):
+    return x + step
+
+
+@register_op("elementwise_add")
+def elementwise_add(x, y, axis: int = -1):
+    return x + _broadcast_to_rank(y, x.ndim, axis)
+
+
+@register_op("elementwise_sub")
+def elementwise_sub(x, y, axis: int = -1):
+    return x - _broadcast_to_rank(y, x.ndim, axis)
+
+
+@register_op("elementwise_mul")
+def elementwise_mul(x, y, axis: int = -1):
+    return x * _broadcast_to_rank(y, x.ndim, axis)
+
+
+@register_op("elementwise_div")
+def elementwise_div(x, y, axis: int = -1):
+    return x / _broadcast_to_rank(y, x.ndim, axis)
+
+
+def _broadcast_to_rank(y, rank: int, axis: int):
+    """Reference broadcast rule (``elementwise_op_function.h``): y's shape
+    matches a contiguous slice of x's dims starting at ``axis``."""
+    if y.ndim == rank or y.ndim == 0:
+        return y
+    if axis < 0:
+        axis = rank - y.ndim
+    shape = [1] * rank
+    for i, d in enumerate(y.shape):
+        shape[axis + i] = d
+    return y.reshape(shape)
+
+
+@register_op("reduce_sum")
+def reduce_sum(x, dim=None, keep_dim: bool = False):
+    return jnp.sum(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_mean")
+def reduce_mean(x, dim=None, keep_dim: bool = False):
+    return jnp.mean(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_max")
+def reduce_max(x, dim=None, keep_dim: bool = False):
+    return jnp.max(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_min")
+def reduce_min(x, dim=None, keep_dim: bool = False):
+    return jnp.min(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("transpose", "trans")
+def transpose(x, axis: Optional[Sequence[int]] = None):
+    return jnp.transpose(x, axes=axis)
+
+
+@register_op("reshape")
+def reshape(x, shape: Sequence[int]):
+    return jnp.reshape(x, shape)
+
+
+@register_op("concat")
+def concat(*xs, axis: int = 1):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_op("split", n_outputs=-1)
+def split(x, num_or_sections, axis: int = 1):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    idx = list(jnp.cumsum(jnp.array(num_or_sections))[:-1])
+    return jnp.split(x, [int(i) for i in idx], axis=axis)
+
+
+@register_op("pad")
+def pad(x, paddings: Sequence[Tuple[int, int]], pad_value: float = 0.0):
+    return jnp.pad(x, paddings, constant_values=pad_value)
+
+
+@register_op("crop")
+def crop(x, offsets: Sequence[int], shape: Sequence[int]):
+    return lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("cast")
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+@register_op("gather")
+def gather(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("scatter")
+def scatter(ref, index, updates, overwrite: bool = True):
+    """Row scatter (``paddle/operators/scatter_op.cc``): functional —
+    returns a new array (reference mutates in place)."""
+    if overwrite:
+        return ref.at[index].set(updates)
+    return ref.at[index].add(updates)
+
+
+@register_op("top_k", n_outputs=2)
+def top_k(x, k: int):
+    """Values+indices of top-k along last dim (``hl_top_k.cu`` replacement —
+    XLA's TopK is already tuned for TPU; no Pallas needed)."""
+    return lax.top_k(x, k)
+
+
+@register_op("multiplex")
+def multiplex(index, *xs):
+    """Row-wise select among candidate tensors by per-row index
+    (``paddle/operators/multiplex_op.cc``)."""
+    stacked = jnp.stack(xs, axis=0)  # [N, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@register_op("fill_constant")
+def fill_constant(shape: Sequence[int], value: float, dtype=jnp.float32):
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ref, shape: Sequence[int], value: float,
+                                  dtype=jnp.float32, input_dim_idx: int = 0,
+                                  output_dim_idx: int = 0):
+    shape = list(shape)
+    shape[output_dim_idx] = ref.shape[input_dim_idx]
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@register_op("gaussian_random")
+def gaussian_random(key, shape: Sequence[int], mean: float = 0.0,
+                    std: float = 1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(key, shape, dtype=dtype)
+
+
+@register_op("uniform_random")
+def uniform_random(key, shape: Sequence[int], min: float = -1.0,
+                   max: float = 1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=min, maxval=max)
+
+
+@register_op("cos_sim")
+def cos_sim(x, y, scale: float = 1.0, eps: float = 1e-10):
+    """Row-wise cosine similarity (``paddle/operators/cos_sim_op.cc``,
+    ``CosSimLayer``); y may have one row (broadcast)."""
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + eps)
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1) + eps)
+    dot = jnp.sum(x * y, axis=-1)
+    return scale * dot / (xn * yn)
+
+
+@register_op("conv_shift")
+def conv_shift(x, y):
+    """Circular 1-D convolution of each row of x with kernel row of y
+    (``paddle/operators/conv_shift_op.cc``).  Kernel width must be odd."""
+    b, m = x.shape
+    _, n = y.shape
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    windows = x[:, idx]  # [B, M, N]
+    return jnp.einsum("bmn,bn->bm", windows, y)
+
+
+@register_op("outer_prod")
+def outer_prod(x, y):
+    """Row-wise outer product flattened (``OuterProdLayer``)."""
+    return (x[:, :, None] * y[:, None, :]).reshape(x.shape[0], -1)
+
+
+@register_op("interpolation")
+def interpolation(w, x, y):
+    """w*x + (1-w)*y with per-row scalar w (``InterpolationLayer``)."""
+    w = w.reshape(-1, 1)
+    return w * x + (1.0 - w) * y
+
+
+@register_op("slope_intercept")
+def slope_intercept(x, slope: float = 1.0, intercept: float = 0.0):
+    return slope * x + intercept
+
+
+@register_op("sum_to_one_norm")
+def sum_to_one_norm(x, eps: float = 1e-12):
+    return x / (jnp.sum(x, axis=-1, keepdims=True) + eps)
+
+
+@register_op("row_l2_norm")
+def row_l2_norm(x, eps: float = 1e-12):
+    return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + eps)
+
+
+@register_op("convex_combination")
+def convex_combination(weights, x):
+    """Per-row convex combination: weights [B, K], x [B, K*D] → [B, D]
+    (``ConvexCombinationLayer``)."""
+    b, k = weights.shape
+    d = x.shape[1] // k
+    return jnp.einsum("bk,bkd->bd", weights, x.reshape(b, k, d))

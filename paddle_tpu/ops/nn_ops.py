@@ -1,0 +1,286 @@
+"""Neural-network ops: conv, pooling, normalization, dropout.
+
+Replaces the reference's cuDNN wrappers (``hl_cuda_cudnn.cc``), the im2col
+GEMM conv path (``paddle/function/GemmConvOp``, ``paddle/operators/math/
+im2col``), pooling (``hl_cnn``/``pool_op``), batch_norm
+(``paddle/operators/batch_norm_op.cc``, ``CudnnBatchNormLayer``), LRN
+(``CrossMapNormLayer``/``lrn_op``), dropout, maxout, bilinear interp, prelu.
+
+TPU-first choices: native ``lax.conv_general_dilated`` (XLA maps convs onto
+the MXU directly — no im2col materialization), **NHWC layout** (channels on
+the 128-lane minor dimension), bf16 compute via the precision policy.  The
+reference's NCHW configs are converted at the layer-engine boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtypes import current_policy
+from .registry import register_op
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@register_op("conv2d")
+def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
+           groups: int = 1, data_format: str = "NHWC"):
+    """2-D convolution.
+
+    x: [N,H,W,C] (NHWC) or [N,C,H,W]; w: [KH,KW,Cin/groups,Cout] (HWIO).
+    Reference: ``ExpandConvLayer``/``conv2d op`` — those im2col+GEMM; XLA
+    lowers this directly to MXU convolutions.
+    """
+    pol = current_policy()
+    x = x.astype(pol.compute_dtype)
+    w = w.astype(pol.compute_dtype)
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 2
+    elif isinstance(padding, (tuple, list)) and isinstance(padding[0], int):
+        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        (data_format, "HWIO", data_format))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=_pair(stride), padding=padding,
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=pol.output_dtype)
+    return out
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, w, stride: IntOr2 = 1, padding="SAME",
+                     data_format: str = "NHWC"):
+    """Transposed conv (``conv2d_transpose_op.cc``). w: [KH,KW,Cout,Cin]."""
+    pol = current_policy()
+    x = x.astype(pol.compute_dtype)
+    w = w.astype(pol.compute_dtype)
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 2
+    out = lax.conv_transpose(
+        x, w, strides=_pair(stride), padding=padding,
+        dimension_numbers=(data_format, "HWIO", data_format),
+        transpose_kernel=True)
+    return out.astype(pol.output_dtype)
+
+
+@register_op("conv3d")
+def conv3d(x, w, stride=1, padding="SAME", data_format: str = "NDHWC"):
+    """3-D convolution (``Conv3DLayer``). x: [N,D,H,W,C]; w: [KD,KH,KW,I,O]."""
+    pol = current_policy()
+    x = x.astype(pol.compute_dtype)
+    w = w.astype(pol.compute_dtype)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "DHWIO", data_format))
+    return lax.conv_general_dilated(
+        x, w, window_strides=s, padding=padding, dimension_numbers=dn,
+        preferred_element_type=pol.output_dtype)
+
+
+def _pool(x, kind: str, window: IntOr2, stride: IntOr2, padding,
+          data_format: str = "NHWC"):
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride)
+    if data_format == "NHWC":
+        dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+        spatial = [1, 2]
+    else:  # NCHW
+        dims, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+        spatial = [2, 3]
+    if isinstance(padding, int):
+        pads = [(0, 0)] * 4
+        for ax in spatial:
+            pads[ax] = (padding, padding)
+    elif isinstance(padding, str):
+        pads = padding
+    else:
+        pads = [(0, 0)] * 4
+        for ax, p in zip(spatial, padding):
+            pads[ax] = _pair(p)
+    if kind == "max":
+        init, op = -jnp.inf, lax.max
+        out = lax.reduce_window(x, jnp.asarray(init, x.dtype), op, dims, strides, pads)
+        return out
+    # avg: exclude padding from the divisor (cuDNN
+    # CUDNN_POOLING_AVERAGE_COUNT_EXCLUDE_PADDING — reference default).
+    summed = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, dims, strides, pads)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add, dims, strides, pads)
+    return summed / counts
+
+
+@register_op("pool2d")
+def pool2d(x, pool_type: str = "max", window: IntOr2 = 2, stride: IntOr2 = 2,
+           padding=0, data_format: str = "NHWC", global_pooling: bool = False):
+    if global_pooling:
+        axes = (1, 2) if data_format == "NHWC" else (2, 3)
+        red = jnp.max if pool_type == "max" else jnp.mean
+        return red(x, axis=axes, keepdims=True)
+    return _pool(x, pool_type, window, stride, padding, data_format)
+
+
+@register_op("max_pool2d_with_index", n_outputs=2)
+def max_pool2d_with_index(x, window: IntOr2 = 2, stride: IntOr2 = 2,
+                          padding: int = 0):
+    """Max pool returning flat spatial argmax indices
+    (``pool_with_index_op``), NHWC."""
+    n, h, w, c = x.shape
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride)
+    pos = jnp.arange(h * w, dtype=jnp.float32).reshape(1, h, w, 1)
+    pos = jnp.broadcast_to(pos, x.shape)
+
+    def select(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    pads = [(0, 0), (padding, padding), (padding, padding), (0, 0)]
+    (vals, idxs) = lax.reduce_window(
+        (x, pos),
+        (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1.0)),
+        select, (1, kh, kw, 1), (1, sh, sw, 1), pads)
+    return vals, idxs.astype(jnp.int32)
+
+
+@register_op("spp")
+def spatial_pyramid_pool(x, pyramid_height: int, pool_type: str = "max"):
+    """Spatial pyramid pooling (``SpatialPyramidPoolLayer``), NHWC → [N, F]."""
+    n, h, w, c = x.shape
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        # adaptive pooling: split H/W into `bins` regions
+        hs = [h * i // bins for i in range(bins + 1)]
+        ws = [w * i // bins for i in range(bins + 1)]
+        for i in range(bins):
+            for j in range(bins):
+                region = x[:, hs[i]:hs[i + 1], ws[j]:ws[j + 1], :]
+                red = jnp.max if pool_type == "max" else jnp.mean
+                outs.append(red(region, axis=(1, 2)))
+    return jnp.concatenate(outs, axis=-1).reshape(n, -1)
+
+
+@register_op("batch_norm", n_outputs=3)
+def batch_norm(x, scale, bias, running_mean, running_var,
+               momentum: float = 0.9, eps: float = 1e-5,
+               is_training: bool = True, data_format: str = "NHWC"):
+    """Batch normalization (``batch_norm_op.cc``, ``BatchNormalizationLayer``).
+
+    Returns (y, new_running_mean, new_running_var).  Stats are computed in
+    fp32 regardless of compute dtype (TPU numerics).
+    """
+    axes = tuple(i for i in range(x.ndim) if i != (x.ndim - 1 if data_format.endswith("C") else 1))
+    xf = x.astype(jnp.float32)
+    if is_training:
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * m
+        new_rv = momentum * running_var + (1 - momentum) * v
+    else:
+        m, v = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    shape = [1] * x.ndim
+    c_ax = x.ndim - 1 if data_format.endswith("C") else 1
+    shape[c_ax] = x.shape[c_ax]
+    inv = lax.rsqrt(v + eps).reshape(shape)
+    y = (xf - m.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+@register_op("lrn")
+def lrn(x, n: int = 5, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75):
+    """Local response normalization across channels, NHWC
+    (``lrn_op.cc``, ``CrossMapNormLayer`` — note gserver uses
+    ``scale = k + alpha * sum``; op uses same form)."""
+    sq = jnp.square(x)
+    half = n // 2
+    pads = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+    sq = jnp.pad(sq, pads)
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + lax.slice_in_dim(sq, i, i + x.shape[-1], axis=-1)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+@register_op("dropout")
+def dropout(x, key, rate: float = 0.5, is_training: bool = True):
+    if not is_training or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_op("maxout")
+def maxout(x, groups: int, data_format: str = "NHWC"):
+    """Max over channel groups (``MaxOutLayer``/``hl_maxout``)."""
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        return jnp.max(x.reshape(n, h, w, c // groups, groups), axis=-1)
+    n, c, h, w = x.shape
+    return jnp.max(x.reshape(n, groups, c // groups, h, w), axis=1)
+
+
+@register_op("prelu")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(x, out_h: int, out_w: int):
+    """Bilinear upsampling, NHWC (``BilinearInterpLayer``/``hl_bilinear``,
+    align_corners-style ratio as the reference computes it)."""
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, out_h, out_w, c), method="bilinear")
+
+
+@register_op("feature_map_expand")
+def feature_map_expand(x, num_filters: int, as_row: bool = True):
+    """Tile a [B, D] input into [B, num_filters*D] (``FeatureMapExpandLayer``)."""
+    b, d = x.shape
+    if as_row:
+        return jnp.tile(x[:, None, :], (1, num_filters, 1)).reshape(b, -1)
+    return jnp.tile(x[:, :, None], (1, 1, num_filters)).reshape(b, -1)
+
+
+@register_op("block_expand")
+def block_expand(x, block_h: int, block_w: int, stride_h: int, stride_w: int,
+                 pad_h: int = 0, pad_w: int = 0):
+    """Image → sequence of flattened patches (``BlockExpandLayer``), NHWC in,
+    [B, S, block_h*block_w*C] out (S = #patches, row-major)."""
+    x = jnp.pad(x, [(0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0)])
+    patches = lax.conv_general_dilated_patches(
+        jnp.moveaxis(x, -1, 1), (block_h, block_w), (stride_h, stride_w),
+        padding="VALID")  # [N, C*bh*bw, OH, OW]
+    n, f, oh, ow = patches.shape
+    return jnp.moveaxis(patches.reshape(n, f, oh * ow), 1, 2)
+
+
+@register_op("rotate")
+def rotate(x, height: int, width: int):
+    """Rotate flattened [B, H*W*C] feature maps 90° CCW (``RotateLayer``)."""
+    b = x.shape[0]
+    c = x.shape[1] // (height * width)
+    img = x.reshape(b, height, width, c)
+    return jnp.rot90(img, k=1, axes=(1, 2)).reshape(b, -1)
+
+
+@register_op("switch_order")
+def switch_order(x, to: str = "NHWC"):
+    """NCHW↔NHWC (``SwitchOrderLayer``, ``paddle/function/SwitchOp``)."""
+    if to == "NHWC":
+        return jnp.moveaxis(x, 1, -1)
+    return jnp.moveaxis(x, -1, 1)

@@ -1,0 +1,51 @@
+"""The op registry.
+
+Replaces the reference's ``OpRegistry``/``REGISTER_OP`` machinery
+(``paddle/framework/op_registry.h:150-217``) and the typed-function registry
+(``paddle/function/Function.h:205``).  An op here is a **pure jax function**;
+there is exactly one implementation per op (XLA compiles it for CPU or TPU),
+so the CPU/GPU kernel split of the reference collapses.  Gradients come from
+jax autodiff — the hand-written ``*_grad`` kernels and the backward
+transpiler's grad-op pairing are replaced by ``jax.vjp`` at whatever
+granularity the caller traces (whole-block under the Executor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, Optional
+
+from ..utils import Registry
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    doc: str = ""
+    n_outputs: int = 1
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.fn(*args, **kwargs)
+
+
+OPS: Registry = Registry("op")
+
+
+def register_op(name: str, *aliases: str, n_outputs: int = 1):
+    """Decorator: expose a pure function as a named framework op."""
+
+    def deco(fn: Callable) -> Callable:
+        OPS.register_value(
+            name,
+            OpDef(name=name, fn=fn, doc=inspect.getdoc(fn) or "", n_outputs=n_outputs),
+            *aliases,
+        )
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OPS.get(name)

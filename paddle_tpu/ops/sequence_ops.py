@@ -1,0 +1,220 @@
+"""Sequence ops over padded :class:`SequenceBatch`.
+
+Re-expresses the reference's offset-vector sequence machinery on dense
+padded layouts: ``SequencePoolLayer``/``sequence_pool_op``,
+``SequenceLastInstanceLayer``, ``ExpandLayer``/``seq_expand_op``,
+``SequenceConcatLayer``, ``SequenceSliceLayer``, ``SequenceReshapeLayer``,
+``ContextProjection`` (``paddle/function/ContextProjectionOp``),
+``sequence_conv_op`` + ``paddle/operators/math/context_project.h``,
+``KmaxSeqScoreLayer``, ``MaxIdLayer``.  Masking replaces the reference's
+per-sequence loops — the ops stay static-shaped so XLA can fuse them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.sequence import SequenceBatch
+from ..utils import PaddleTpuError
+from .registry import register_op
+
+
+@register_op("sequence_pool")
+def sequence_pool(seq: SequenceBatch, pool_type: str = "average") -> jax.Array:
+    """Pool [B, T, D] over valid timesteps → [B, D].
+
+    pool types: average, sum, sqrt (sum/sqrt(len)), max, last, first.
+    Reference: ``SequencePoolLayer`` subclasses + ``sequence_pool_op``.
+    """
+    x = seq.data
+    mask = seq.mask(x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    denom = jnp.maximum(seq.length.astype(x.dtype), 1.0)
+    denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type in ("average", "avg", "mean"):
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type == "sqrt":
+        return jnp.sum(x * mask, axis=1) / jnp.sqrt(denom)
+    if pool_type == "max":
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        pooled = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+        # all-empty sequences pool to 0 (reference leaves them zeroed)
+        return jnp.where(denom > 0, pooled, 0.0)
+    if pool_type == "last":
+        return seq.last_valid()
+    if pool_type == "first":
+        return seq.first_valid()
+    raise PaddleTpuError(f"unknown pool type {pool_type!r}")
+
+
+@register_op("seq_expand", "expand")
+def seq_expand(x: jax.Array, like: SequenceBatch) -> SequenceBatch:
+    """Broadcast per-sequence rows [B, D] across time of ``like`` → [B, T, D]
+    (``ExpandLayer`` non-seq→seq mode, ``seq_expand_op``)."""
+    t = like.max_len
+    data = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    return SequenceBatch(data=data, length=like.length)
+
+
+@register_op("sequence_concat")
+def sequence_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
+    """Concatenate each pair of sequences in time (``SequenceConcatLayer``).
+
+    Implemented with a roll-based shift so shapes stay static: b's valid
+    prefix is placed right after a's valid prefix.
+    """
+    ta, tb = a.max_len, b.max_len
+    d = a.data.shape[2:]
+    out_t = ta + tb
+    pad_a = jnp.pad(a.data, [(0, 0), (0, tb)] + [(0, 0)] * len(d))
+    pad_b = jnp.pad(b.data, [(0, 0), (0, ta)] + [(0, 0)] * len(d))
+
+    def shift(row, n):
+        return jnp.roll(row, n, axis=0)
+
+    shifted_b = jax.vmap(shift)(pad_b, a.length)
+    t_idx = jnp.arange(out_t, dtype=jnp.int32)
+    in_a = t_idx[None, :] < a.length[:, None]
+    in_b = (t_idx[None, :] >= a.length[:, None]) & (
+        t_idx[None, :] < (a.length + b.length)[:, None])
+    sel_a = in_a.reshape(in_a.shape + (1,) * len(d))
+    sel_b = in_b.reshape(in_b.shape + (1,) * len(d))
+    data = jnp.where(sel_a, pad_a, jnp.where(sel_b, shifted_b, 0))
+    return SequenceBatch(data=data, length=a.length + b.length)
+
+
+@register_op("sequence_slice")
+def sequence_slice(seq: SequenceBatch, offset, length) -> SequenceBatch:
+    """Per-sequence subsequence [offset, offset+length) (``SequenceSliceLayer``).
+
+    offset/length: [B] int arrays (or scalars).  Output keeps T static.
+    """
+    b, t = seq.data.shape[:2]
+    offset = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (b,))
+    length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
+    length = jnp.minimum(length, seq.length - offset)
+
+    def shift(row, n):
+        return jnp.roll(row, -n, axis=0)
+
+    data = jax.vmap(shift)(seq.data, offset)
+    return SequenceBatch(data=data, length=jnp.maximum(length, 0))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(seq: SequenceBatch, new_dim: int) -> SequenceBatch:
+    """Refactor [B, T, D] → [B, T*D/new_dim, new_dim] preserving valid counts
+    (``SequenceReshapeLayer``).  Valid lengths must divide evenly at runtime
+    (the reference enforces the same)."""
+    b, t, d = seq.data.shape
+    data = seq.data.reshape(b, t * d // new_dim, new_dim)
+    length = seq.length * d // new_dim
+    return SequenceBatch(data=data, length=length)
+
+
+@register_op("context_projection")
+def context_projection(seq: SequenceBatch, context_start: int,
+                       context_length: int,
+                       padding_weights: Optional[jax.Array] = None) -> SequenceBatch:
+    """Concatenate a sliding window of neighbor rows per timestep
+    → [B, T, context_length*D].
+
+    Reference: ``ContextProjection`` (``paddle/function/ContextProjectionOp``)
+    — out-of-range rows are zeros, or trainable begin/end padding rows when
+    ``padding_weights`` ([begin_pad+end_pad, D]) is given.
+    """
+    b, t, d = seq.data.shape
+    begin_pad = max(0, -context_start)
+    cols = []
+    for k in range(context_length):
+        off = context_start + k
+        rolled = jnp.roll(seq.data, -off, axis=1)
+        t_idx = jnp.arange(t, dtype=jnp.int32)[None, :]
+        src = t_idx + off
+        valid = (src >= 0) & (src < seq.length[:, None])
+        col = jnp.where(valid[..., None], rolled, 0.0)
+        if padding_weights is not None:
+            if off < 0:
+                # positions before the sequence use begin-pad row (begin_pad+off ... )
+                pad_row = padding_weights[begin_pad + off]
+                col = jnp.where((src < 0)[..., None], pad_row, col)
+            elif off > 0:
+                # positions past the end use end-pad rows indexed by overflow-1
+                overflow = jnp.clip(src - seq.length[:, None], 0, off)
+                pad_idx = begin_pad + overflow - 1
+                pad_val = padding_weights[jnp.clip(pad_idx, 0, padding_weights.shape[0] - 1)]
+                use_pad = (src >= seq.length[:, None]) & (t_idx < seq.length[:, None])
+                col = jnp.where(use_pad[..., None], pad_val, col)
+        cols.append(col)
+    out = jnp.concatenate(cols, axis=-1)
+    return SequenceBatch(data=out, length=seq.length)
+
+
+@register_op("sequence_conv")
+def sequence_conv(seq: SequenceBatch, w, context_start: int,
+                  context_length: int) -> SequenceBatch:
+    """Context window + projection (``sequence_conv_op``): w is
+    [context_length*D, Dout]."""
+    ctx = context_projection(seq, context_start, context_length)
+    from .math_ops import matmul
+
+    return SequenceBatch(data=matmul(ctx.data, w), length=seq.length)
+
+
+@register_op("kmax_seq_score")
+def kmax_seq_score(scores: SequenceBatch, beam_size: int) -> jax.Array:
+    """Indices of the top-k scores within each sequence
+    (``KmaxSeqScoreLayer``) → [B, beam_size] int32, -1 past seq end."""
+    s = scores.data
+    if s.ndim == 3:
+        s = s[..., 0]
+    masked = jnp.where(scores.bool_mask(), s, -jnp.inf)
+    vals, idx = lax.top_k(masked, beam_size)
+    k_in_range = jnp.arange(beam_size)[None, :] < scores.length[:, None]
+    return jnp.where(k_in_range, idx, -1)
+
+
+@register_op("max_id")
+def max_id(x: jax.Array, beam_size: int = 1):
+    """Per-row argmax ids (``MaxIdLayer``); beam_size>1 → top-k ids."""
+    if beam_size == 1:
+        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+    _, idx = lax.top_k(x, beam_size)
+    return idx.astype(jnp.int32)
+
+
+@register_op("sub_seq")
+def sub_seq(seq: SequenceBatch, offsets, sizes) -> SequenceBatch:
+    """Alias of sequence_slice with explicit offset/size inputs
+    (``SubSequenceLayer``)."""
+    return sequence_slice(seq, offsets, sizes)
+
+
+@register_op("sequence_last_instance")
+def sequence_last_instance(seq: SequenceBatch) -> jax.Array:
+    return seq.last_valid()
+
+
+@register_op("sequence_first_instance")
+def sequence_first_instance(seq: SequenceBatch) -> jax.Array:
+    return seq.first_valid()
+
+
+@register_op("row_conv")
+def row_conv(seq: SequenceBatch, w) -> SequenceBatch:
+    """Lookahead row convolution (``RowConvLayer``/``row_conv op``):
+    w [future_context, D]; out[t] = sum_k w[k] * x[t+k]."""
+    k = w.shape[0]
+    acc = jnp.zeros_like(seq.data)
+    t_idx = jnp.arange(seq.max_len, dtype=jnp.int32)[None, :]
+    for i in range(k):
+        rolled = jnp.roll(seq.data, -i, axis=1)
+        valid = (t_idx + i) < seq.length[:, None]
+        acc = acc + jnp.where(valid[..., None], rolled * w[i], 0.0)
+    return SequenceBatch(data=acc, length=seq.length)
