@@ -1,0 +1,315 @@
+"""First-order optimizers as pure jitted update rules.
+
+Reference: ``paddle/parameter/FirstOrderOptimizer.h:24-335`` (SGD, momentum,
+Adagrad, AdaDelta, RMSProp, DecayedAdagrad, Adam, Adamax), the optimizer math
+kernels (``paddle/math/TrainingAlgorithmOp.h:67-122``), regularizers
+(``Regularizer.h``), gradient clipping (``trainer_config_helpers/
+optimizers.py`` gradient_clipping_threshold), and parameter averaging
+(``AverageOptimizer.h``).
+
+Design: an :class:`Optimizer` holds static hyperparameters; ``init(params)``
+builds a state pytree and ``apply(params, grads, state, lr)`` returns
+``(new_params, new_state)`` — a pure function that runs **inside** the jitted
+train step (and therefore inside ``shard_map``, where each replica applies
+identical updates after the gradient all-reduce).  This replaces the whole
+``ParameterUpdater`` class family for the local path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import Registry
+
+OPTIMIZERS: Registry = Registry("optimizer")
+
+PyTree = Any
+
+
+def tree_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+@dataclasses.dataclass
+class Optimizer:
+    """Base class; subclasses define per-leaf slot init and update math."""
+
+    learning_rate: float = 0.01
+    # L2 ("decay_rate" in ParameterConfig) applied as grad += decay * param,
+    # matching OptimizerWithRegularizer semantics for dense params.
+    weight_decay: float = 0.0
+    l1_decay: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+
+    def init(self, params: PyTree) -> list:
+        """Slot list aligned with the flattened parameter leaves."""
+        leaves = jax.tree_util.tree_leaves(params)
+        return [self._init_slot(p) for p in leaves]
+
+    def _init_slot(self, p):
+        return ()
+
+    def _update(self, p, g, slot, lr, count):
+        raise NotImplementedError
+
+    def apply(self, params: PyTree, grads: PyTree, state: PyTree,
+              lr: Optional[jax.Array] = None,
+              lr_scales: Optional[PyTree] = None
+              ) -> Tuple[PyTree, PyTree]:
+        lr = jnp.asarray(self.learning_rate if lr is None else lr, jnp.float32)
+        count, slots = state
+        count = count + 1
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        if lr_scales is None:
+            scale_leaves = [None] * len(p_leaves)
+        else:
+            scale_leaves = treedef.flatten_up_to(lr_scales)
+        if self.gradient_clipping_threshold > 0:
+            # reference clips per-parameter elementwise by threshold
+            t = self.gradient_clipping_threshold
+            g_leaves = [jnp.clip(g, -t, t) for g in g_leaves]
+        if self.weight_decay:
+            g_leaves = [g + self.weight_decay * p
+                        for g, p in zip(g_leaves, p_leaves)]
+        new_p, new_slots = [], []
+        for p, g, slot, sc in zip(p_leaves, g_leaves, slots, scale_leaves):
+            eff_lr = lr if sc is None else lr * sc
+            np_, ns = self._update(p, g, slot, eff_lr, count)
+            if self.l1_decay:
+                shrink = eff_lr * self.l1_decay
+                np_ = jnp.sign(np_) * jnp.maximum(jnp.abs(np_) - shrink, 0.0)
+            new_p.append(np_)
+            new_slots.append(ns)
+        return treedef.unflatten(new_p), (count, new_slots)
+
+    def init_state(self, params: PyTree) -> Tuple[jax.Array, list]:
+        return (jnp.zeros((), jnp.int32), self.init(params))
+
+
+@OPTIMIZERS.register("sgd")
+@dataclasses.dataclass
+class SGD(Optimizer):
+    """Plain SGD (``SgdOptimizer``)."""
+
+    def _update(self, p, g, slot, lr, count):
+        return (p - lr * g).astype(p.dtype), slot
+
+
+@OPTIMIZERS.register("momentum")
+@dataclasses.dataclass
+class Momentum(Optimizer):
+    """Momentum SGD (``sgdUpdate`` in TrainingAlgorithmOp.h):
+    v = mom*v - lr*g ; p += v."""
+
+    momentum: float = 0.9
+
+    def _init_slot(self, p):
+        return (jnp.zeros_like(p),)
+
+    def _update(self, p, g, slot, lr, count):
+        (v,) = slot
+        v = self.momentum * v - lr * g
+        return (p + v).astype(p.dtype), (v,)
+
+
+@OPTIMIZERS.register("adagrad")
+@dataclasses.dataclass
+class Adagrad(Optimizer):
+    """``AdagradOptimizer``: accum += g^2; p -= lr*g/(sqrt(accum)+eps)."""
+
+    epsilon: float = 1e-6
+
+    def _init_slot(self, p):
+        return (jnp.zeros_like(p, dtype=jnp.float32),)
+
+    def _update(self, p, g, slot, lr, count):
+        (acc,) = slot
+        acc = acc + jnp.square(g)
+        step = lr * g / (jnp.sqrt(acc) + self.epsilon)
+        return (p - step).astype(p.dtype), (acc,)
+
+
+@OPTIMIZERS.register("adadelta")
+@dataclasses.dataclass
+class AdaDelta(Optimizer):
+    """``AdaDeltaOptimizer`` (rou/epsilon as in adadeltaApply)."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return (z, z)
+
+    def _update(self, p, g, slot, lr, count):
+        eg2, edx2 = slot
+        eg2 = self.rho * eg2 + (1 - self.rho) * jnp.square(g)
+        dx = jnp.sqrt((edx2 + self.epsilon) / (eg2 + self.epsilon)) * g
+        edx2 = self.rho * edx2 + (1 - self.rho) * jnp.square(dx)
+        return (p - lr * dx).astype(p.dtype), (eg2, edx2)
+
+
+@OPTIMIZERS.register("rmsprop")
+@dataclasses.dataclass
+class RMSProp(Optimizer):
+    """``RMSPropOptimizer`` — the centered variant the reference implements
+    (keeps E[g] as well as E[g^2]; rmspropApply in TrainingAlgorithmOp)."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return (z, z)
+
+    def _update(self, p, g, slot, lr, count):
+        eg2, eg = slot
+        eg2 = self.rho * eg2 + (1 - self.rho) * jnp.square(g)
+        eg = self.rho * eg + (1 - self.rho) * g
+        step = lr * g / jnp.sqrt(eg2 - jnp.square(eg) + self.epsilon)
+        return (p - step).astype(p.dtype), (eg2, eg)
+
+
+@OPTIMIZERS.register("decayed_adagrad")
+@dataclasses.dataclass
+class DecayedAdagrad(Optimizer):
+    """``DecayedAdagradOptimizer``: like RMSProp without centering."""
+
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def _init_slot(self, p):
+        return (jnp.zeros_like(p, dtype=jnp.float32),)
+
+    def _update(self, p, g, slot, lr, count):
+        (acc,) = slot
+        acc = self.rho * acc + (1 - self.rho) * jnp.square(g)
+        step = lr * g / jnp.sqrt(acc + self.epsilon)
+        return (p - step).astype(p.dtype), (acc,)
+
+
+@OPTIMIZERS.register("adam")
+@dataclasses.dataclass
+class Adam(Optimizer):
+    """``AdamOptimizer`` (adamApply): bias-corrected moments."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return (z, z)
+
+    def _update(self, p, g, slot, lr, count):
+        m, v = slot
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        t = count.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        step = lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return (p - step).astype(p.dtype), (m, v)
+
+
+@OPTIMIZERS.register("adamax")
+@dataclasses.dataclass
+class Adamax(Optimizer):
+    """``AdamaxOptimizer`` (adamaxApply): infinity-norm second moment."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def _init_slot(self, p):
+        z = jnp.zeros_like(p, dtype=jnp.float32)
+        return (z, z)
+
+    def _update(self, p, g, slot, lr, count):
+        m, u = slot
+        g32 = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g32))
+        t = count.astype(jnp.float32)
+        step = lr / (1 - jnp.power(self.beta1, t)) * m / (u + self.epsilon)
+        return (p - step).astype(p.dtype), (m, u)
+
+
+@OPTIMIZERS.register("proximal_gd")
+@dataclasses.dataclass
+class ProximalGD(Optimizer):
+    """``proximal_gd_op``: SGD + proximal L1/L2 shrinkage."""
+
+    l1: float = 0.0
+    l2: float = 0.0
+
+    def _update(self, p, g, slot, lr, count):
+        prox = p - lr * g
+        if self.l1:
+            prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0)
+        return (prox / (1.0 + lr * self.l2)).astype(p.dtype), slot
+
+
+@OPTIMIZERS.register("proximal_adagrad")
+@dataclasses.dataclass
+class ProximalAdagrad(Optimizer):
+    """``proximal_adagrad_op``."""
+
+    l1: float = 0.0
+    l2: float = 0.0
+    epsilon: float = 1e-6
+
+    def _init_slot(self, p):
+        return (jnp.zeros_like(p, dtype=jnp.float32),)
+
+    def _update(self, p, g, slot, lr, count):
+        (acc,) = slot
+        acc = acc + jnp.square(g)
+        eff = lr / (jnp.sqrt(acc) + self.epsilon)
+        prox = p - eff * g
+        if self.l1:
+            prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff * self.l1, 0.0)
+        return (prox / (1.0 + eff * self.l2)).astype(p.dtype), (acc,)
+
+
+@dataclasses.dataclass
+class ModelAverage:
+    """Parameter averaging over a sliding window
+    (``AverageOptimizer.h`` / v2 ``ModelAverage``).
+
+    Keeps a running sum; ``average(state)`` yields eval-time params.
+    average_window is the fraction of recent updates to average over
+    (reference semantics: window grows up to max_average_window).
+    """
+
+    average_window: float = 0.5
+    max_average_window: int = 10000
+
+    def init(self, params):
+        return {
+            "sum": tree_map(lambda p: p.astype(jnp.float32), params),
+            "count": jnp.ones((), jnp.float32),
+        }
+
+    def accumulate(self, state, params):
+        # restart window when it exceeds max
+        count = state["count"] + 1
+        reset = count > self.max_average_window
+        new_sum = tree_map(
+            lambda s, p: jnp.where(reset, p.astype(jnp.float32),
+                                   s + p.astype(jnp.float32)),
+            state["sum"], params)
+        return {"sum": new_sum, "count": jnp.where(reset, 1.0, count)}
+
+    def average(self, state):
+        return tree_map(lambda s: s / state["count"], state["sum"])
+
+
+def create_optimizer(name: str, **kwargs) -> Optimizer:
+    return OPTIMIZERS.create(name, **kwargs)

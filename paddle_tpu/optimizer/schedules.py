@@ -1,0 +1,104 @@
+"""Learning-rate schedules.
+
+Reference: ``paddle/parameter/LearningRateScheduler.cpp:50-172`` — schedules
+are keyed by the number of **samples processed** (pass_manual by pass id).
+All are pure functions of (base_lr, progress) so they trace into jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..utils import ConfigError, Registry
+
+SCHEDULES: Registry = Registry("lr schedule")
+
+
+def _reg(name):
+    def deco(fn):
+        SCHEDULES.register_value(name, fn)
+        return fn
+
+    return deco
+
+
+@_reg("constant")
+def constant(base_lr, num_samples, a=0.0, b=0.0):
+    return jnp.asarray(base_lr, jnp.float32)
+
+
+@_reg("poly")
+def poly(base_lr, num_samples, a=1.0, b=0.0):
+    """lr * (1 + a*n)^(-b)  (reference 'poly': a=gamma, b=power)."""
+    return base_lr * jnp.power(1.0 + a * num_samples, -b)
+
+
+@_reg("caffe_poly")
+def caffe_poly(base_lr, num_samples, a=1.0, b=0.0):
+    """lr * (1 - n/a)^b  (a=max steps, b=power)."""
+    return base_lr * jnp.power(1.0 - num_samples / a, b)
+
+
+@_reg("exp")
+def exp(base_lr, num_samples, a=0.5, b=1.0):
+    """lr * a^(n/b)."""
+    return base_lr * jnp.power(a, num_samples / b)
+
+
+@_reg("discexp")
+def discexp(base_lr, num_samples, a=0.5, b=1.0):
+    """lr * a^floor(n/b)."""
+    return base_lr * jnp.power(a, jnp.floor(num_samples / b))
+
+
+@_reg("linear")
+def linear(base_lr, num_samples, a=0.0, b=0.0):
+    """max(lr - a*n, b)."""
+    return jnp.maximum(base_lr - a * num_samples, b)
+
+
+def parse_manual_spec(spec: str) -> Tuple[Sequence[float], Sequence[float]]:
+    """Parse 'seg0:lr0,seg1:lr1,...' (learning_rate_args for manual modes)."""
+    bounds, rates = [], []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        seg, lr = part.split(":")
+        bounds.append(float(seg))
+        rates.append(float(lr))
+    return bounds, rates
+
+
+def manual(base_lr, progress, spec: str):
+    bounds, rates = parse_manual_spec(spec)
+    lr = jnp.asarray(rates[-1], jnp.float32) * base_lr
+    for bound, rate in zip(reversed(bounds[:-1]), reversed(rates[:-1])):
+        lr = jnp.where(progress < bound, rate * base_lr, lr)
+    # first segment
+    lr = jnp.where(progress < bounds[0], rates[0] * base_lr, lr)
+    return lr
+
+
+SCHEDULES.register_value("manual", manual)
+SCHEDULES.register_value("pass_manual", manual)
+
+
+def make_schedule(name: str = "constant", base_lr: float = 0.01,
+                  decay_a: float = 0.0, decay_b: float = 0.0,
+                  args: str = ""):
+    """Build lr(num_samples_or_pass) from config fields
+    (learning_rate_schedule / learning_rate_decay_a/_b / learning_rate_args)."""
+    name = name or "constant"
+    if name not in SCHEDULES:
+        raise ConfigError(f"unknown learning_rate_schedule {name!r}")
+    fn = SCHEDULES.get(name)
+    if name in ("manual", "pass_manual"):
+        return lambda progress: fn(base_lr, progress, args)
+    kw = {}
+    if decay_a:
+        kw["a"] = decay_a
+    if decay_b:
+        kw["b"] = decay_b
+    return lambda progress: fn(base_lr, progress, **kw)
