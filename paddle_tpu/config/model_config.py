@@ -1,0 +1,202 @@
+"""Model configuration IR.
+
+The reference routes every model through protobuf ``ModelConfig``
+(``proto/ModelConfig.proto:637``, ``LayerConfig:347``, ``ParameterConfig``),
+produced by the Python DSLs and consumed by the C++ engine.  Here the IR is
+plain dataclasses with the same field vocabulary (names follow the proto) —
+serializable to/from JSON for checkpoint metadata and inspection.  The v1/v2
+layer DSLs in :mod:`paddle_tpu.config.layers_v2` compile to this IR, and
+:class:`paddle_tpu.layers.network.NeuralNetwork` executes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils import ConfigError, enforce
+
+
+@dataclass
+class ParameterConfig:
+    """Mirror of ``proto/ParameterConfig.proto`` (the trainable-weight spec)."""
+
+    name: str = ""
+    size: int = 0
+    dims: List[int] = field(default_factory=list)
+    learning_rate: float = 1.0          # per-parameter lr scale
+    momentum: float = 0.0
+    decay_rate: float = 0.0             # L2
+    decay_rate_l1: float = 0.0          # L1
+    initial_mean: float = 0.0
+    initial_std: float = 0.01
+    initial_strategy: int = 0           # 0: normal, 1: uniform
+    initial_smart: bool = False         # std = 1/sqrt(fan_in)
+    is_static: bool = False
+    is_sparse: bool = False
+    sparse_update: bool = False
+    sharded: bool = False               # TPU: shard over 'model' axis
+
+
+@dataclass
+class ProjConfig:
+    """Projection/operator inside a mixed layer (``ProjectionConfig``)."""
+
+    type: str = "fc"                    # fc|identity|dot_mul|scaling|table|context|slice
+    input_size: int = 0
+    output_size: int = 0
+    context_start: int = 0
+    context_length: int = 0
+    trainable_padding: bool = False
+    slice_begin: int = 0
+    slice_end: int = 0
+
+
+@dataclass
+class LayerInput:
+    """One input edge of a layer (``LayerInputConfig``)."""
+
+    input_layer_name: str = ""
+    input_parameter_name: str = ""
+    proj: Optional[ProjConfig] = None
+    # conv/pool/norm/image-specific geometry (ConvConfig/PoolConfig/NormConfig)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class LayerConfig:
+    """Mirror of ``proto/ModelConfig.proto:347`` LayerConfig."""
+
+    name: str = ""
+    type: str = ""
+    size: int = 0
+    active_type: str = ""
+    inputs: List[LayerInput] = field(default_factory=list)
+    bias_parameter_name: str = ""
+    with_bias: bool = False
+    drop_rate: float = 0.0
+    # free-form per-type attributes (pool type, conv geometry, context, ...)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    # device hint (--parallel_nn per-layer placement → sharding annotation)
+    device: int = -1
+
+    def input_names(self) -> List[str]:
+        return [i.input_layer_name for i in self.inputs]
+
+
+@dataclass
+class SubModelConfig:
+    """Recurrent-group sub-model (``SubModelConfig`` — in/out links,
+    memories; reference ``config_parser.py:367`` RecurrentLayerGroupBegin)."""
+
+    name: str = ""
+    layer_names: List[str] = field(default_factory=list)
+    in_links: List[str] = field(default_factory=list)
+    out_links: List[str] = field(default_factory=list)
+    memories: List[Dict[str, Any]] = field(default_factory=list)
+    reversed: bool = False
+    is_generating: bool = False
+    generator: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ModelConfig:
+    """Mirror of ``proto/ModelConfig.proto:637``."""
+
+    layers: List[LayerConfig] = field(default_factory=list)
+    parameters: List[ParameterConfig] = field(default_factory=list)
+    input_layer_names: List[str] = field(default_factory=list)
+    output_layer_names: List[str] = field(default_factory=list)
+    sub_models: List[SubModelConfig] = field(default_factory=list)
+
+    def layer_map(self) -> Dict[str, LayerConfig]:
+        return {l.name: l for l in self.layers}
+
+    def param_map(self) -> Dict[str, ParameterConfig]:
+        return {p.name: p for p in self.parameters}
+
+    def find_layer(self, name: str) -> LayerConfig:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise ConfigError(f"no layer named {name!r}")
+
+    def find_size(self, name: str) -> int:
+        """Size of a layer output OR a recurrent-group memory link."""
+        for l in self.layers:
+            if l.name == name:
+                return l.size
+        for sm in self.sub_models:
+            for mem in sm.memories:
+                if mem.get("link_name") == name or \
+                        mem.get("layer_name") + "@pre" == name:
+                    size = mem.get("size", 0)
+                    return size or self.find_layer(mem["layer_name"]).size
+        raise ConfigError(f"no layer or memory link named {name!r}")
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=1)
+
+    @staticmethod
+    def from_json(text: str) -> "ModelConfig":
+        raw = json.loads(text)
+
+        def mk_input(d):
+            proj = ProjConfig(**d["proj"]) if d.get("proj") else None
+            return LayerInput(
+                input_layer_name=d.get("input_layer_name", ""),
+                input_parameter_name=d.get("input_parameter_name", ""),
+                proj=proj, attrs=d.get("attrs", {}))
+
+        return ModelConfig(
+            layers=[
+                LayerConfig(
+                    **{**l, "inputs": [mk_input(i) for i in l.get("inputs", [])]})
+                for l in raw.get("layers", [])
+            ],
+            parameters=[ParameterConfig(**p) for p in raw.get("parameters", [])],
+            input_layer_names=raw.get("input_layer_names", []),
+            output_layer_names=raw.get("output_layer_names", []),
+            sub_models=[SubModelConfig(**s) for s in raw.get("sub_models", [])],
+        )
+
+
+@dataclass
+class OptimizationConfig:
+    """Mirror of ``proto/TrainerConfig.proto`` OptimizationConfig +
+    ``OptimizerConfig.proto``."""
+
+    batch_size: int = 32
+    learning_rate: float = 0.01
+    learning_method: str = "sgd"
+    learning_rate_schedule: str = "constant"
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_args: str = ""
+    momentum: float = 0.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_epsilon: float = 1e-8
+    ada_epsilon: float = 1e-6
+    ada_rou: float = 0.95
+    l1_weight_decay: float = 0.0
+    l2_weight_decay: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+    average_window: float = 0.0
+    max_average_window: int = 0
+    num_batches_per_send_parameter: int = 1
+    num_batches_per_get_parameter: int = 1
+
+
+@dataclass
+class TrainerConfig:
+    """Mirror of ``proto/TrainerConfig.proto:140``."""
+
+    model_config: ModelConfig = field(default_factory=ModelConfig)
+    opt_config: OptimizationConfig = field(default_factory=OptimizationConfig)
+    num_passes: int = 1
+    save_dir: str = "./output"
+    test_period: int = 0
+    log_period: int = 100

@@ -1,0 +1,13 @@
+from .base import LAYERS, ForwardContext, Layer, init_parameter, register_layer
+from .network import NeuralNetwork
+from .recurrent_group import RecurrentGroup
+
+__all__ = [
+    "LAYERS",
+    "ForwardContext",
+    "Layer",
+    "NeuralNetwork",
+    "RecurrentGroup",
+    "init_parameter",
+    "register_layer",
+]

@@ -1,0 +1,132 @@
+"""Layer base class and registry.
+
+Equivalent of ``paddle/gserver/layers/Layer.h:62`` (base) and the
+``REGISTER_LAYER`` macro (``:31``, registrar ``:260``).
+
+TPU-first contract: a layer is **stateless and functional** — it declares
+parameter shapes from its :class:`LayerConfig` and computes
+``forward(params, inputs)`` as a pure jax function.  There is no
+``backward()``: the whole network's forward is traced and autodiffed as one
+XLA computation, which replaces the reference's per-layer hand-written
+gradients while keeping the per-layer *configuration* surface identical.
+
+Batch-norm-style running statistics live in a separate ``buffers`` pytree
+(returned updated from forward), and dropout randomness comes from a
+per-layer folded PRNG key — both threaded by the NeuralNetwork.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import LayerConfig, ModelConfig, ParameterConfig
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops import get_activation
+from ..ops.nn_ops import dropout as dropout_op
+from ..utils import ConfigError, Registry, enforce
+
+LAYERS: Registry = Registry("layer")
+
+
+def register_layer(*names: str):
+    def deco(cls):
+        LAYERS.register_value(names[0], cls, *names[1:])
+        cls.layer_type = names[0]
+        return cls
+
+    return deco
+
+
+@dataclasses.dataclass
+class ForwardContext:
+    """Per-call context threaded through layer forwards."""
+
+    is_training: bool = True
+    rng: Optional[jax.Array] = None
+    buffers: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    new_buffers: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def layer_rng(self, name: str) -> jax.Array:
+        if self.rng is None:
+            return jax.random.PRNGKey(0)
+        return jax.random.fold_in(self.rng, abs(hash(name)) % (2 ** 31))
+
+
+class Layer:
+    """Base layer. Subclasses override ``param_specs`` and ``forward``."""
+
+    layer_type = ""
+
+    def __init__(self, conf: LayerConfig, model: ModelConfig):
+        self.conf = conf
+        self.name = conf.name
+        self.model = model
+
+    # ---- parameters ------------------------------------------------------
+    def param_specs(self) -> List[ParameterConfig]:
+        """Parameter configs this layer owns (weights then bias)."""
+        return []
+
+    def weight_name(self, i: int = 0) -> str:
+        inp = self.conf.inputs[i]
+        return inp.input_parameter_name or f"_{self.name}.w{i}"
+
+    def bias_name(self) -> str:
+        return self.conf.bias_parameter_name or f"_{self.name}.wbias"
+
+    def _weight_spec(self, i: int, shape: Sequence[int], **kw) -> ParameterConfig:
+        return ParameterConfig(
+            name=self.weight_name(i), size=int(np.prod(shape)),
+            dims=list(shape), **kw)
+
+    def _bias_spec(self, shape: Sequence[int], **kw) -> ParameterConfig:
+        return ParameterConfig(
+            name=self.bias_name(), size=int(np.prod(shape)),
+            dims=list(shape), initial_std=0.0, **kw)
+
+    # ---- execution -------------------------------------------------------
+    def forward(self, params: Dict[str, jax.Array], inputs: List[Any],
+                ctx: ForwardContext) -> Any:
+        raise NotImplementedError
+
+    def apply_activation(self, out: Any) -> Any:
+        act = get_activation(self.conf.active_type or None)
+        if self.conf.active_type == "sequence_softmax" and isinstance(out, SequenceBatch):
+            return out.with_data(act(out.data, mask=out.mask()))
+        if isinstance(out, SequenceBatch):
+            return out.with_data(act(out.data))
+        return act(out)
+
+    def apply_dropout(self, out: Any, ctx: ForwardContext) -> Any:
+        if self.conf.drop_rate > 0:
+            data = value_of(out)
+            data = dropout_op(data, ctx.layer_rng(self.name + "/drop"),
+                              rate=self.conf.drop_rate,
+                              is_training=ctx.is_training)
+            return like(out, data)
+        return out
+
+    def finalize(self, out: Any, ctx: ForwardContext) -> Any:
+        """Activation then dropout, matching Layer::forwardActivation order."""
+        return self.apply_dropout(self.apply_activation(out), ctx)
+
+
+def init_parameter(key: jax.Array, spec: ParameterConfig) -> jax.Array:
+    """Initialize one parameter per ``ParameterConfig`` semantics
+    (initial_strategy/mean/std/smart — ``paddle/parameter/Parameter.cpp``)."""
+    shape = tuple(spec.dims) if spec.dims else (spec.size,)
+    std = spec.initial_std
+    if spec.initial_smart and len(shape) >= 2:
+        std = 1.0 / np.sqrt(shape[0])
+    if std == 0.0:
+        base = jnp.zeros(shape, jnp.float32)
+    elif spec.initial_strategy == 1:
+        base = jax.random.uniform(key, shape, jnp.float32, -std, std)
+    else:
+        base = std * jax.random.normal(key, shape, jnp.float32)
+    return base + spec.initial_mean

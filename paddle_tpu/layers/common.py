@@ -1,0 +1,500 @@
+"""Dense / glue layers.
+
+Covers the reference families (``paddle/gserver/layers``): DataLayer,
+FullyConnectedLayer, MixedLayer + projections (FullMatrix, Identity, DotMul,
+Scaling, Table, Context, Slice — ``paddle/gserver/layers/Projection.h``
+family), AddtoLayer, ConcatenateLayer, embedding (TableProjection as a
+layer), SelectiveFc, InterpolationLayer, OuterProdLayer, PowerLayer,
+ScalingLayer, SlopeInterceptLayer, ConvexCombinationLayer, CosSimLayer,
+CosSimVecMatLayer, SumToOneNormLayer, RowL2NormLayer, TransLayer,
+ResizeLayer, ClipLayer, ScaleShiftLayer, ParameterReluLayer, MultiplexLayer,
+DotProdLayer, FeatureMapExpandLayer, TensorLayer, NCELayer,
+HierarchicalSigmoidLayer, PrintLayer, DataNormLayer.
+
+Layer *type strings* match the reference's registered names so configs
+translate 1:1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import LayerConfig, ParameterConfig
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops import embedding_ops, math_ops, sequence_ops
+from ..utils import ConfigError, enforce
+from .base import ForwardContext, Layer, register_layer
+
+
+def map_value(fn, x):
+    return like(x, fn(value_of(x)))
+
+
+def flatten_image(v: jax.Array) -> jax.Array:
+    """NHWC image tensor → flat [B, C*H*W] rows in the reference's CHW
+    element order (so fc weights keep reference-compatible layout)."""
+    if v.ndim == 4:
+        return jnp.moveaxis(v, -1, 1).reshape(v.shape[0], -1)
+    return v.reshape(v.shape[0], -1)
+
+
+def _flat_apply(fn, x):
+    """Apply a [N, D] → [N, D'] function across batch (and time) dims.
+
+    SequenceBatch data [B, T, D] is applied per-timestep; raw arrays of
+    rank > 2 (image tensors) are flattened to [B, C*H*W] like the reference's
+    row-matrix layout.
+    """
+    v = value_of(x)
+    if isinstance(x, SequenceBatch) and v.ndim > 2:
+        lead = v.shape[:-1]
+        out = fn(v.reshape(-1, v.shape[-1]))
+        out = out.reshape(lead + out.shape[1:])
+    elif v.ndim > 2:
+        out = fn(flatten_image(v))
+    else:
+        out = fn(v)
+    return like(x, out)
+
+
+@register_layer("data")
+class DataLayer(Layer):
+    """Feed entry point (``DataLayer.cpp``); value comes from the feed dict."""
+
+    def forward(self, params, inputs, ctx):
+        raise ConfigError("data layers are fed, not computed")
+
+
+@register_layer("fc")
+class FullyConnectedLayer(Layer):
+    """``FullyConnectedLayer``: out = act(sum_i x_i W_i + b)."""
+
+    def param_specs(self):
+        specs = []
+        for i, inp in enumerate(self.conf.inputs):
+            in_size = self.conf.attrs.get(f"input_size{i}") or \
+                self.model.find_size(inp.input_layer_name)
+            specs.append(self._weight_spec(
+                i, (in_size, self.conf.size), initial_smart=True))
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((self.conf.size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        out = None
+        for i, x in enumerate(inputs):
+            w = params[self.weight_name(i)]
+            y = _flat_apply(lambda v: math_ops.matmul(v, w), x)
+            out = y if out is None else like(y, value_of(out) + value_of(y))
+        if self.conf.with_bias:
+            out = map_value(lambda v: v + params[self.bias_name()], out)
+        return self.finalize(out, ctx)
+
+
+@register_layer("embedding")
+class EmbeddingLayer(Layer):
+    """Table lookup (v1: table_projection; kept as a first-class layer)."""
+
+    def param_specs(self):
+        vocab = self.conf.attrs["vocab_size"]
+        return [self._weight_spec(0, (vocab, self.conf.size),
+                                  initial_smart=True,
+                                  sharded=self.conf.attrs.get("sharded", False))]
+
+    def forward(self, params, inputs, ctx):
+        table = params[self.weight_name(0)]
+        ids = value_of(inputs[0])
+        out = embedding_ops.lookup_table(table, ids)
+        if ids.ndim >= 2 and out.ndim == ids.ndim + 1:
+            pass
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("addto")
+class AddtoLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        out = value_of(inputs[0])
+        for x in inputs[1:]:
+            out = out + value_of(x)
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+    def param_specs(self):
+        return [self._bias_spec((self.conf.size,))] if self.conf.with_bias else []
+
+
+@register_layer("concat")
+class ConcatLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        vals = [value_of(x) for x in inputs]
+        return self.finalize(like(inputs[0], jnp.concatenate(vals, axis=-1)), ctx)
+
+
+@register_layer("mixed")
+class MixedLayer(Layer):
+    """``MixedLayer``: sum of per-input projections (+ optional operators).
+
+    Projection types (per input's ProjConfig): fc, identity, dot_mul,
+    scaling, table, context, slice; operator 'dot_mul_operator' over two
+    inputs via attrs.
+    """
+
+    def _proj(self, i):
+        p = self.conf.inputs[i].proj
+        if p is None:
+            raise ConfigError(f"mixed layer {self.name} input {i} has no projection")
+        return p
+
+    def param_specs(self):
+        specs = []
+        for i, inp in enumerate(self.conf.inputs):
+            p = inp.proj
+            if p is None:
+                continue
+            if p.type == "fc":
+                specs.append(self._weight_spec(
+                    i, (p.input_size, self.conf.size), initial_smart=True))
+            elif p.type == "dot_mul":
+                specs.append(self._weight_spec(i, (self.conf.size,),
+                                               initial_mean=1.0, initial_std=0.0))
+            elif p.type == "scaling":
+                specs.append(self._weight_spec(i, (1,), initial_mean=1.0,
+                                               initial_std=0.0))
+            elif p.type == "table":
+                specs.append(self._weight_spec(
+                    i, (p.input_size, self.conf.size), initial_smart=True))
+            elif p.type == "context" and p.trainable_padding:
+                begin = max(0, -p.context_start)
+                end = max(0, p.context_start + p.context_length - 1)
+                specs.append(self._weight_spec(
+                    i, (begin + end, p.input_size)))
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((self.conf.size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        out = None
+        template = inputs[0]
+        for i, x in enumerate(inputs):
+            p = self._proj(i)
+            v = value_of(x)
+            if p.type == "fc":
+                y = _flat_apply(lambda t: math_ops.matmul(t, params[self.weight_name(i)]), x)
+                y = value_of(y)
+            elif p.type == "identity":
+                y = v
+            elif p.type == "dot_mul":
+                y = v * params[self.weight_name(i)]
+            elif p.type == "scaling":
+                y = v * params[self.weight_name(i)][0]
+            elif p.type == "table":
+                y = embedding_ops.lookup_table(params[self.weight_name(i)], v)
+            elif p.type == "context":
+                enforce(isinstance(x, SequenceBatch),
+                        "context projection needs a sequence input")
+                pad_w = params.get(self.weight_name(i)) if p.trainable_padding else None
+                y = value_of(sequence_ops.context_projection(
+                    x, p.context_start, p.context_length, pad_w))
+                template = x
+            elif p.type == "slice":
+                y = v[..., p.slice_begin:p.slice_end]
+            else:
+                raise ConfigError(f"unknown projection type {p.type!r}")
+            out = y if out is None else out + y
+        if self.conf.attrs.get("dot_mul_operator"):
+            out = value_of(inputs[0]) * value_of(inputs[1]) * \
+                self.conf.attrs.get("dotmul_scale", 1.0)
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(template, out), ctx)
+
+
+@register_layer("selective_fc")
+class SelectiveFcLayer(Layer):
+    def param_specs(self):
+        in_size = self.model.find_size(self.conf.inputs[0].input_layer_name)
+        specs = [self._weight_spec(0, (in_size, self.conf.size), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((self.conf.size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        sel = value_of(inputs[1]).astype(jnp.int32) if len(inputs) > 1 else None
+        out = embedding_ops.selective_fc(
+            x, params[self.weight_name(0)],
+            params.get(self.bias_name()) if self.conf.with_bias else None,
+            sel, act=self.conf.active_type or "linear")
+        return like(inputs[0], out)
+
+
+@register_layer("interpolation")
+class InterpolationLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        w, x, y = (value_of(i) for i in inputs)
+        return self.finalize(like(inputs[1], math_ops.interpolation(w, x, y)), ctx)
+
+
+@register_layer("out_prod")
+class OuterProdLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return self.finalize(
+            like(inputs[0], math_ops.outer_prod(value_of(inputs[0]),
+                                                value_of(inputs[1]))), ctx)
+
+
+@register_layer("power")
+class PowerLayer(Layer):
+    """out = x ^ w with per-row scalar exponent w (first input)."""
+
+    def forward(self, params, inputs, ctx):
+        w = value_of(inputs[0]).reshape(-1, 1)
+        x = value_of(inputs[1])
+        return self.finalize(like(inputs[1], jnp.power(x, w)), ctx)
+
+
+@register_layer("scaling")
+class ScalingLayer(Layer):
+    """Row-wise scale: weight (first input, [B,1]) * x (second input)."""
+
+    def forward(self, params, inputs, ctx):
+        w = value_of(inputs[0]).reshape(-1, 1)
+        x = value_of(inputs[1])
+        return self.finalize(like(inputs[1], w * x), ctx)
+
+
+@register_layer("slope_intercept")
+class SlopeInterceptLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        out = math_ops.slope_intercept(
+            value_of(inputs[0]), self.conf.attrs.get("slope", 1.0),
+            self.conf.attrs.get("intercept", 0.0))
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("convex_comb")
+class ConvexCombinationLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return self.finalize(
+            like(inputs[1], math_ops.convex_combination(
+                value_of(inputs[0]), value_of(inputs[1]))), ctx)
+
+
+@register_layer("cos")
+class CosSimLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        out = math_ops.cos_sim(value_of(inputs[0]), value_of(inputs[1]),
+                               scale=self.conf.attrs.get("cos_scale", 1.0))
+        return like(inputs[0], out.reshape(-1, 1))
+
+
+@register_layer("cos_vm")
+class CosSimVecMatLayer(Layer):
+    """cosine of vec [B, D] against each row of mat [B, K*D] → [B, K]."""
+
+    def forward(self, params, inputs, ctx):
+        vec = value_of(inputs[0])
+        mat = value_of(inputs[1])
+        b, d = vec.shape
+        k = mat.shape[1] // d
+        m = mat.reshape(b, k, d)
+        dot = jnp.einsum("bd,bkd->bk", vec, m)
+        nv = jnp.linalg.norm(vec, axis=-1, keepdims=True)
+        nm = jnp.linalg.norm(m, axis=-1)
+        out = self.conf.attrs.get("cos_scale", 1.0) * dot / (nv * nm + 1e-10)
+        return like(inputs[0], out)
+
+
+@register_layer("sum_to_one_norm")
+class SumToOneNormLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return map_value(math_ops.sum_to_one_norm, inputs[0])
+
+
+@register_layer("row_l2_norm")
+class RowL2NormLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return map_value(math_ops.row_l2_norm, inputs[0])
+
+
+@register_layer("trans")
+class TransLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return like(inputs[0], jnp.swapaxes(value_of(inputs[0]), -1, -2))
+
+
+@register_layer("resize")
+class ResizeLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        v = value_of(inputs[0])
+        return like(inputs[0], v.reshape(-1, self.conf.size))
+
+
+@register_layer("clip")
+class ClipLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return map_value(
+            lambda v: jnp.clip(v, self.conf.attrs.get("min", -1.0),
+                               self.conf.attrs.get("max", 1.0)), inputs[0])
+
+
+@register_layer("scale_shift")
+class ScaleShiftLayer(Layer):
+    def param_specs(self):
+        specs = [self._weight_spec(0, (1,), initial_mean=1.0, initial_std=0.0)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((1,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        out = value_of(inputs[0]) * params[self.weight_name(0)][0]
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()][0]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("prelu")
+class ParameterReluLayer(Layer):
+    def param_specs(self):
+        partial_sum = self.conf.attrs.get("partial_sum", 1)
+        n = self.conf.size // partial_sum
+        return [self._weight_spec(0, (n,), initial_mean=0.25, initial_std=0.0)]
+
+    def forward(self, params, inputs, ctx):
+        alpha = params[self.weight_name(0)]
+        partial = self.conf.attrs.get("partial_sum", 1)
+        v = value_of(inputs[0])
+        a = jnp.repeat(alpha, partial)[: v.shape[-1]]
+        return like(inputs[0], jnp.where(v >= 0, v, a * v))
+
+
+@register_layer("multiplex")
+class MultiplexLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        idx = value_of(inputs[0]).reshape(-1)
+        return like(inputs[1],
+                    math_ops.multiplex(idx, *[value_of(x) for x in inputs[1:]]))
+
+
+@register_layer("dot_prod")
+class DotProdLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        out = jnp.sum(value_of(inputs[0]) * value_of(inputs[1]), axis=-1,
+                      keepdims=True)
+        return like(inputs[0], out)
+
+
+@register_layer("featmap_expand")
+class FeatureMapExpandLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        from ..ops.nn_ops import feature_map_expand
+
+        return map_value(
+            lambda v: feature_map_expand(
+                v, self.conf.attrs["num_filters"],
+                self.conf.attrs.get("as_row_vector", True)), inputs[0])
+
+
+@register_layer("tensor")
+class TensorLayer(Layer):
+    """``TensorLayer``: out_k = x1 W_k x2^T per output unit k."""
+
+    def param_specs(self):
+        d1 = self.model.find_size(self.conf.inputs[0].input_layer_name)
+        d2 = self.model.find_size(self.conf.inputs[1].input_layer_name)
+        specs = [self._weight_spec(0, (self.conf.size, d1, d2), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((self.conf.size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x1, x2 = value_of(inputs[0]), value_of(inputs[1])
+        w = params[self.weight_name(0)]
+        out = jnp.einsum("bi,kij,bj->bk", x1, w, x2)
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("nce")
+class NCELayer(Layer):
+    def param_specs(self):
+        d = self.model.find_size(self.conf.inputs[0].input_layer_name)
+        num_classes = self.conf.attrs["num_classes"]
+        specs = [self._weight_spec(0, (num_classes, d), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((num_classes,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        labels = value_of(inputs[1]).reshape(-1)
+        num_classes = self.conf.attrs["num_classes"]
+        num_neg = self.conf.attrs.get("num_neg_samples", 10)
+        key = ctx.layer_rng(self.name)
+        sample_ids = jax.random.randint(key, (x.shape[0], num_neg), 0, num_classes)
+        probs = jnp.full((x.shape[0], num_neg), 1.0 / num_classes)
+        b = params.get(self.bias_name())
+        if b is None:
+            b = jnp.zeros(num_classes)
+        cost = embedding_ops.nce_loss(
+            x, labels, params[self.weight_name(0)], b, sample_ids, probs)
+        return like(inputs[0], cost.reshape(-1, 1))
+
+
+@register_layer("hsigmoid")
+class HierarchicalSigmoidLayer(Layer):
+    def param_specs(self):
+        d = self.model.find_size(self.conf.inputs[0].input_layer_name)
+        num_classes = self.conf.attrs["num_classes"]
+        specs = [self._weight_spec(0, (num_classes - 1, d), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((num_classes - 1,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        labels = value_of(inputs[1]).reshape(-1)
+        num_classes = self.conf.attrs["num_classes"]
+        b = params.get(self.bias_name())
+        if b is None:
+            b = jnp.zeros(num_classes - 1)
+        cost = embedding_ops.hierarchical_sigmoid(
+            x, labels, params[self.weight_name(0)], b, num_classes)
+        return like(inputs[0], cost.reshape(-1, 1))
+
+
+@register_layer("data_norm")
+class DataNormLayer(Layer):
+    """z-score/min-max/decimal scaling normalization with fixed stats
+    (``DataNormLayer`` — stats provided via attrs, not learned)."""
+
+    def forward(self, params, inputs, ctx):
+        strategy = self.conf.attrs.get("data_norm_strategy", "z-score")
+        v = value_of(inputs[0])
+        if strategy == "z-score":
+            mean = jnp.asarray(self.conf.attrs.get("mean", 0.0))
+            std = jnp.asarray(self.conf.attrs.get("std", 1.0))
+            out = (v - mean) / jnp.maximum(std, 1e-8)
+        elif strategy == "min-max":
+            mn = jnp.asarray(self.conf.attrs.get("min", 0.0))
+            mx = jnp.asarray(self.conf.attrs.get("max", 1.0))
+            out = (v - mn) / jnp.maximum(mx - mn, 1e-8)
+        else:  # decimal-scaling
+            a = jnp.asarray(self.conf.attrs.get("a", 1.0))
+            out = v / a
+        return like(inputs[0], out)
+
+
+@register_layer("print")
+class PrintLayer(Layer):
+    """Host-side debug print (``PrintLayer``) via jax.debug.print."""
+
+    def forward(self, params, inputs, ctx):
+        jax.debug.print(self.name + ": {}", value_of(inputs[0]))
+        return inputs[0]

@@ -1,0 +1,303 @@
+"""Image layers: conv, pool, norm, batch-norm, and shape glue.
+
+Reference: ``ExpandConvLayer`` (type ``exconv``), ``ConvTransLayer``
+(``exconvt``), ``CudnnConvLayer`` (``cudnn_conv`` — same math here, XLA owns
+the kernel choice), ``PoolLayer``/``CudnnPoolLayer`` (``pool``),
+``NormLayer`` (``norm``, cmrnorm-projection), ``BatchNormalizationLayer`` /
+``CudnnBatchNormLayer`` (``batch_norm``/``cudnn_batch_norm``),
+``MaxOutLayer``, ``BlockExpandLayer``, ``SpatialPyramidPoolLayer``,
+``PadLayer``, ``CropLayer``, ``RotateLayer``, ``SwitchOrderLayer``,
+``BilinearInterpLayer``, ``Conv3DLayer``/``DeConv3DLayer``.
+
+Geometry attrs mirror ``ConvConfig``/``PoolConfig`` in ModelConfig.proto:
+channels, filter_size(_y), stride(_y), padding(_y), num_filters, img_size(_y),
+groups, pool_size(_y), output_x/_y (caffe_mode floor arithmetic).
+
+Internal layout is **NHWC** (TPU lane-friendly); inputs arriving as the
+reference's flat [B, C*H*W] rows are reshaped (CHW order preserved), and
+outputs flatten back the same way when a dense layer consumes them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import ParameterConfig
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops import nn_ops
+from ..utils import ConfigError, enforce
+from .base import ForwardContext, Layer, register_layer
+
+
+def conv_out_size(img: int, filt: int, pad: int, stride: int,
+                  caffe_mode: bool = True) -> int:
+    """``cnn_output_size`` (paddle/math/MathUtil): floor (caffe) or ceil."""
+    if caffe_mode:
+        return (img + 2 * pad - filt) // stride + 1
+    return (img + 2 * pad - filt + stride - 1) // stride + 1
+
+
+def to_nhwc(v: jax.Array, channels: int, height: int, width: int) -> jax.Array:
+    """Accept [B, C*H*W] flat rows (reference layout) or already-NHWC."""
+    if v.ndim == 2:
+        b = v.shape[0]
+        return jnp.moveaxis(v.reshape(b, channels, height, width), 1, -1)
+    if v.ndim == 4:
+        return v
+    raise ConfigError(f"cannot interpret image input of rank {v.ndim}")
+
+
+class _ImgLayer(Layer):
+    """Shared geometry helpers."""
+
+    def geo(self, key: str, default=None):
+        val = self.conf.attrs.get(key, default)
+        if val is None:
+            raise ConfigError(f"layer {self.name}: missing conv attr {key!r}")
+        return val
+
+
+@register_layer("exconv", "cudnn_conv", "conv")
+class ConvLayer(_ImgLayer):
+    def _shapes(self):
+        c = self.geo("channels")
+        f = self.geo("filter_size")
+        fy = self.conf.attrs.get("filter_size_y", f)
+        nf = self.geo("num_filters")
+        groups = self.conf.attrs.get("groups", 1)
+        return c, f, fy, nf, groups
+
+    def param_specs(self):
+        c, f, fy, nf, groups = self._shapes()
+        # HWIO layout
+        specs = [self._weight_spec(0, (fy, f, c // groups, nf),
+                                   initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((nf,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        c, f, fy, nf, groups = self._shapes()
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        stride = (self.conf.attrs.get("stride_y", self.conf.attrs.get("stride", 1)),
+                  self.conf.attrs.get("stride", 1))
+        pad = (self.conf.attrs.get("padding_y", self.conf.attrs.get("padding", 0)),
+               self.conf.attrs.get("padding", 0))
+        out = nn_ops.conv2d(x, params[self.weight_name(0)], stride=stride,
+                            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                            groups=groups)
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("exconvt", "cudnn_convt")
+class ConvTransLayer(_ImgLayer):
+    def param_specs(self):
+        c = self.geo("channels")
+        f = self.geo("filter_size")
+        fy = self.conf.attrs.get("filter_size_y", f)
+        nf = self.geo("num_filters")
+        specs = [self._weight_spec(0, (fy, f, nf, c), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((nf,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        stride = self.conf.attrs.get("stride", 1)
+        pad = self.conf.attrs.get("padding", 0)
+        out = nn_ops.conv2d_transpose(
+            x, params[self.weight_name(0)], stride=stride,
+            padding=[(pad, pad), (pad, pad)])
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("pool", "cudnn_pool")
+class PoolLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        ptype = self.geo("pool_type", "max-projection")
+        kind = "max" if "max" in ptype else "avg"
+        window = (self.conf.attrs.get("size_y", self.conf.attrs.get("pool_size", 2)),
+                  self.conf.attrs.get("pool_size", 2))
+        stride = (self.conf.attrs.get("stride_y", self.conf.attrs.get("stride", 2)),
+                  self.conf.attrs.get("stride", 2))
+        pad = (self.conf.attrs.get("padding_y", self.conf.attrs.get("padding", 0)),
+               self.conf.attrs.get("padding", 0))
+        out = nn_ops.pool2d(x, kind, window=window, stride=stride,
+                            padding=list(pad))
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("norm")
+class NormLayer(_ImgLayer):
+    """cmrnorm-projection (cross-map LRN)."""
+
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        size = self.conf.attrs.get("norm_size", 5)
+        scale = self.conf.attrs.get("scale", 1e-4)
+        pow_ = self.conf.attrs.get("pow", 0.75)
+        # gserver semantics: scale is already divided by size in config_parser
+        out = nn_ops.lrn(x, n=size, k=1.0, alpha=scale, beta=pow_)
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm")
+class BatchNormLayer(_ImgLayer):
+    """Batch normalization with running-stat buffers.
+
+    The reference stores moving mean/var as extra non-learnable parameters
+    (use_global_stats at inference); here they live in the buffers pytree.
+    """
+
+    def param_specs(self):
+        c = self.conf.attrs.get("channels", self.conf.size)
+        specs = [self._weight_spec(0, (c,), initial_mean=1.0, initial_std=0.0)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((c,)))
+        return specs
+
+    def buffer_specs(self):
+        c = self.conf.attrs.get("channels", self.conf.size)
+        return {
+            self.name + ".mean": jnp.zeros((c,), jnp.float32),
+            self.name + ".var": jnp.ones((c,), jnp.float32),
+        }
+
+    def forward(self, params, inputs, ctx):
+        c = self.conf.attrs.get("channels", self.conf.size)
+        v = value_of(inputs[0])
+        img = v
+        was_flat = v.ndim == 2 and self.conf.attrs.get("img_size") is not None
+        if was_flat:
+            h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+            w = self.geo("img_size")
+            img = to_nhwc(v, c, h, w)
+        bias = params.get(self.bias_name())
+        if bias is None:
+            bias = jnp.zeros((c,), jnp.float32)
+        rm = ctx.buffers.get(self.name + ".mean", jnp.zeros((c,), jnp.float32))
+        rv = ctx.buffers.get(self.name + ".var", jnp.ones((c,), jnp.float32))
+        momentum = self.conf.attrs.get("moving_average_fraction", 0.9)
+        use_global = self.conf.attrs.get("use_global_stats", None)
+        training = ctx.is_training if use_global is None else not use_global
+        y, nrm, nrv = nn_ops.batch_norm(
+            img, params[self.weight_name(0)], bias, rm, rv,
+            momentum=momentum, is_training=training)
+        ctx.new_buffers[self.name + ".mean"] = nrm
+        ctx.new_buffers[self.name + ".var"] = nrv
+        return self.finalize(like(inputs[0], y), ctx)
+
+
+@register_layer("maxout")
+class MaxOutLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        return self.finalize(
+            like(inputs[0], nn_ops.maxout(x, self.geo("groups"))), ctx)
+
+
+@register_layer("blockexpand")
+class BlockExpandLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        out = nn_ops.block_expand(
+            x, self.geo("block_y"), self.geo("block_x"),
+            self.geo("stride_y"), self.geo("stride_x"),
+            self.conf.attrs.get("padding_y", 0), self.conf.attrs.get("padding_x", 0))
+        b, s, d = out.shape
+        return SequenceBatch(data=out, length=jnp.full((b,), s, jnp.int32))
+
+
+@register_layer("spp")
+class SppLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        out = nn_ops.spatial_pyramid_pool(
+            x, self.geo("pyramid_height"),
+            "max" if "max" in self.conf.attrs.get("pool_type", "max") else "avg")
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("pad")
+class PadLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        pc = self.conf.attrs.get("pad_c", [0, 0])
+        ph = self.conf.attrs.get("pad_h", [0, 0])
+        pw = self.conf.attrs.get("pad_w", [0, 0])
+        out = jnp.pad(x, [(0, 0), tuple(ph), tuple(pw), tuple(pc)])
+        return like(inputs[0], out)
+
+
+@register_layer("crop")
+class CropLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        offs = self.conf.attrs.get("crop_offsets", [0, 0])
+        shape = self.conf.attrs["crop_shape"]  # [H, W]
+        out = x[:, offs[0]:offs[0] + shape[0], offs[1]:offs[1] + shape[1], :]
+        return like(inputs[0], out)
+
+
+@register_layer("rotate")
+class RotateLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        h = self.geo("height")
+        w = self.geo("width")
+        from ..ops.nn_ops import rotate
+
+        return like(inputs[0], rotate(value_of(inputs[0]), h, w))
+
+
+@register_layer("switch_order")
+class SwitchOrderLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        return like(inputs[0], nn_ops.switch_order(
+            value_of(inputs[0]), self.conf.attrs.get("to", "NHWC")))
+
+
+@register_layer("bilinear_interp")
+class BilinearInterpLayer(_ImgLayer):
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
+        out = nn_ops.bilinear_interp(
+            x, self.geo("out_size_y"), self.geo("out_size_x"))
+        return like(inputs[0], out)

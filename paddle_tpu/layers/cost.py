@@ -1,0 +1,206 @@
+"""Cost layers.
+
+Reference: ``paddle/gserver/layers/CostLayer.cpp`` (registered type strings
+kept: ``multi-class-cross-entropy``, ``multi_class_cross_entropy_with_selfnorm``,
+``soft_binary_class_cross_entropy``, ``square_error``, ``rank-cost``,
+``lambda_cost``, ``multi_binary_label_cross_entropy``, ``huber_regression``,
+``huber_classification``, ``smooth_l1``, ``sum_cost``), plus ``CRFLayer``
+(``crf``), ``CRFDecodingLayer`` (``crf_decoding``), ``CTCLayer`` (``ctc``),
+``WarpCTCLayer`` (``warp_ctc``), ``CrossEntropyOverBeam``.
+
+A cost layer outputs **per-example cost** [B, 1]; masking/sequence weighting
+happens here; the network sums cost-layer outputs into the scalar objective
+(``Argument::sum`` equivalent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops import crf_ops, loss_ops
+from ..utils import enforce
+from .base import ForwardContext, Layer, register_layer
+
+
+def _per_example(out, template):
+    return like(template, out.reshape(-1, 1))
+
+
+class _CostBase(Layer):
+    is_cost = True
+
+    def weighted(self, cost, inputs):
+        """Apply optional per-example weight input (3rd input)."""
+        if len(inputs) > 2 and inputs[2] is not None:
+            w = value_of(inputs[2]).reshape(-1)
+            cost = cost * w
+        coeff = self.conf.attrs.get("coeff", 1.0)
+        return cost * coeff
+
+
+def _masked_flatten_seq(x, label):
+    """For sequence inputs, flatten time into batch with mask weights."""
+    if isinstance(x, SequenceBatch):
+        v = x.data
+        b, t = v.shape[:2]
+        mask = x.mask(jnp.float32).reshape(b * t)
+        lab = value_of(label)
+        if lab.ndim >= 2 and lab.shape[:2] == (b, t):
+            lab = lab.reshape((b * t,) + lab.shape[2:])
+        return v.reshape((b * t,) + v.shape[2:]), lab, mask
+    return value_of(x), value_of(label), None
+
+
+@register_layer("multi-class-cross-entropy")
+class CrossEntropyCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        x, label, mask = _masked_flatten_seq(inputs[0], inputs[1])
+        cost = loss_ops.cross_entropy(x, label.reshape(-1))
+        if mask is not None:
+            cost = cost * mask
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("multi_class_cross_entropy_with_selfnorm")
+class CrossEntropySelfNormCost(_CostBase):
+    """CE + alpha * log(Z)^2 self-normalization (CostLayer.cpp)."""
+
+    def forward(self, params, inputs, ctx):
+        x, label, mask = _masked_flatten_seq(inputs[0], inputs[1])
+        logz = jnp.log(jnp.sum(x, axis=-1) + 1e-8)
+        cost = loss_ops.cross_entropy(x, label.reshape(-1)) + \
+            self.conf.attrs.get("softmax_selfnorm_alpha", 0.1) * jnp.square(logz)
+        if mask is not None:
+            cost = cost * mask
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("soft_binary_class_cross_entropy")
+class SoftBinaryCrossEntropyCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        label = value_of(inputs[1])
+        eps = 1e-8
+        p = jnp.clip(x, eps, 1 - eps)
+        cost = -jnp.sum(label * jnp.log(p) + (1 - label) * jnp.log1p(-p), axis=-1)
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("square_error", "mse", "regression_cost")
+class SquareErrorCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        x, label, mask = _masked_flatten_seq(inputs[0], inputs[1])
+        cost = loss_ops.square_error(x, label)
+        if mask is not None:
+            cost = cost * mask
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("rank-cost")
+class RankingCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        cost = loss_ops.rank_loss(value_of(inputs[0]), value_of(inputs[1]),
+                                  value_of(inputs[2]))
+        coeff = self.conf.attrs.get("coeff", 1.0)
+        return _per_example(cost * coeff, inputs[0])
+
+
+@register_layer("lambda_cost")
+class LambdaCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        scores = inputs[0]
+        gains = inputs[1]
+        enforce(isinstance(scores, SequenceBatch), "lambda_cost needs sequences")
+        cost = loss_ops.lambda_cost(
+            scores.data[..., 0] if scores.data.ndim == 3 else scores.data,
+            value_of(gains)[..., 0] if value_of(gains).ndim == 3 else value_of(gains),
+            scores.mask(), self.conf.attrs.get("NDCG_num", 5))
+        return _per_example(cost, inputs[0])
+
+
+@register_layer("multi_binary_label_cross_entropy")
+class MultiBinaryLabelCrossEntropyCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        cost = loss_ops.multi_binary_label_cross_entropy(
+            value_of(inputs[0]), value_of(inputs[1]))
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("huber_regression")
+class HuberRegressionCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        cost = loss_ops.huber_loss(value_of(inputs[0]), value_of(inputs[1]),
+                                   self.conf.attrs.get("delta", 1.0))
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("huber_classification")
+class HuberClassificationCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        cost = loss_ops.huber_classification_cost(
+            value_of(inputs[0]), value_of(inputs[1]))
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("smooth_l1")
+class SmoothL1Cost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        cost = loss_ops.smooth_l1_loss(value_of(inputs[0]), value_of(inputs[1]))
+        return _per_example(self.weighted(cost, inputs), inputs[0])
+
+
+@register_layer("sum_cost")
+class SumCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        return _per_example(jnp.sum(x.reshape(x.shape[0], -1), axis=-1), inputs[0])
+
+
+@register_layer("crf")
+class CRFCost(_CostBase):
+    """Linear-chain CRF NLL (``CRFLayer``); weight [N+2, N]."""
+
+    def param_specs(self):
+        n = self.conf.size
+        return [self._weight_spec(0, (n + 2, n), initial_std=0.01)]
+
+    def forward(self, params, inputs, ctx):
+        emissions = inputs[0]
+        labels = inputs[1]
+        enforce(isinstance(emissions, SequenceBatch), "crf needs sequences")
+        lab = labels if isinstance(labels, SequenceBatch) else \
+            SequenceBatch(data=value_of(labels), length=emissions.length)
+        cost = crf_ops.crf_nll(emissions, lab, params[self.weight_name(0)])
+        return _per_example(self.weighted(cost, inputs), emissions)
+
+
+@register_layer("crf_decoding")
+class CRFDecodingLayer(Layer):
+    def param_specs(self):
+        n = self.conf.size
+        return [self._weight_spec(0, (n + 2, n), initial_std=0.01)]
+
+    def forward(self, params, inputs, ctx):
+        emissions = inputs[0]
+        decoded = crf_ops.crf_decode(emissions, params[self.weight_name(0)])
+        if len(inputs) > 1:  # label given → output per-position error
+            lab = value_of(inputs[1])
+            err = (decoded.data != lab[..., : decoded.data.shape[1]]).astype(jnp.float32)
+            return SequenceBatch(data=err * decoded.mask(), length=decoded.length)
+        return decoded
+
+
+@register_layer("ctc", "warp_ctc")
+class CTCCost(_CostBase):
+    def forward(self, params, inputs, ctx):
+        logits = inputs[0]
+        labels = inputs[1]
+        enforce(isinstance(logits, SequenceBatch) and isinstance(labels, SequenceBatch),
+                "ctc needs sequence logits and labels")
+        cost = crf_ops.ctc_loss(
+            logits, labels,
+            blank=self.conf.attrs.get("blank", 0),
+            norm_by_times=self.conf.attrs.get("norm_by_times", False))
+        return _per_example(cost, logits)

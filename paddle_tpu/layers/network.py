@@ -1,0 +1,190 @@
+"""NeuralNetwork: config-driven executor over the layer registry.
+
+Equivalent of ``paddle/gserver/gradientmachines/NeuralNetwork.cpp`` — but
+where the reference loops layers twice (``forward:245`` / ``backward:295``
+with hand-written per-layer gradients), here :meth:`forward` is a **pure
+traceable function** and the backward pass is jax autodiff over the whole
+graph, so the entire fwd+bwd+update compiles into one XLA computation
+(the SURVEY §7 north-star jit path).
+
+Handles: topological execution, parameter creation/sharing
+(``input_parameter_name``), static parameters, batch-norm buffers, cost
+aggregation (``Argument::sum``), and recurrent-group sub-models (delegated
+to :class:`paddle_tpu.layers.recurrent_group.RecurrentGroup`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import LayerConfig, ModelConfig, ParameterConfig
+from ..core.sequence import SequenceBatch, value_of
+from ..utils import ConfigError, enforce, global_stat, layer_stack
+from .base import LAYERS, ForwardContext, Layer, init_parameter
+from . import common, conv, cost, rnn, seq  # noqa: F401  (register layers)
+
+
+class NeuralNetwork:
+    """Builds and executes a ModelConfig as a functional graph."""
+
+    def __init__(self, config: ModelConfig):
+        self.config = config
+        self.layers: Dict[str, Layer] = {}
+        self.order: List[str] = []
+        sub_layer_names: Set[str] = set()
+        self.group_of: Dict[str, str] = {}
+        for sm in config.sub_models:
+            if sm.name == "root":
+                continue
+            for ln in sm.layer_names:
+                sub_layer_names.add(ln)
+                self.group_of[ln] = sm.name
+
+        from .recurrent_group import RecurrentGroup
+
+        self.groups: Dict[str, "RecurrentGroup"] = {}
+        for sm in config.sub_models:
+            if sm.name != "root" and not sm.is_generating:
+                self.groups[sm.name] = RecurrentGroup(sm, config)
+        self.gen_groups = {
+            sm.name: sm for sm in config.sub_models
+            if sm.name != "root" and sm.is_generating
+        }
+
+        for lconf in config.layers:
+            if lconf.name in sub_layer_names and lconf.type != "data":
+                continue  # executed inside its recurrent group
+            cls = LAYERS.get(lconf.type)
+            self.layers[lconf.name] = cls(lconf, config)
+            self.order.append(lconf.name)
+
+        # parameter specs (merge layer-declared with config-declared)
+        declared = {p.name: p for p in config.parameters}
+        self.param_specs: Dict[str, ParameterConfig] = {}
+        self._collect_specs(self.layers.values(), declared)
+        for g in self.groups.values():
+            self._collect_specs(g.layers.values(), declared)
+        self.static_params: Set[str] = {
+            n for n, s in self.param_specs.items() if s.is_static}
+
+        self.data_layers = [n for n in self.order
+                            if self.layers[n].conf.type == "data"]
+        self.cost_layers = [
+            n for n in self.order
+            if getattr(self.layers[n], "is_cost", False)]
+        self.output_names = config.output_layer_names or (
+            [self.order[-1]] if self.order else [])
+
+    def _collect_specs(self, layers, declared) -> None:
+        for layer in layers:
+            for spec in layer.param_specs():
+                if spec.name in declared:
+                    d = declared[spec.name]
+                    if not d.dims:
+                        d.dims = spec.dims
+                    d.size = d.size or spec.size
+                    spec = d
+                if spec.name in self.param_specs:
+                    prev = self.param_specs[spec.name]
+                    enforce(prev.dims == spec.dims,
+                            f"shared parameter {spec.name} shape mismatch: "
+                            f"{prev.dims} vs {spec.dims}")
+                    continue
+                self.param_specs[spec.name] = spec
+
+    # ------------------------------------------------------------- params
+    def init_params(self, seed: int = 1) -> Dict[str, jax.Array]:
+        key = jax.random.PRNGKey(seed)
+        params = {}
+        for i, (name, spec) in enumerate(sorted(self.param_specs.items())):
+            params[name] = init_parameter(jax.random.fold_in(key, i), spec)
+        return params
+
+    def init_buffers(self) -> Dict[str, jax.Array]:
+        buffers: Dict[str, jax.Array] = {}
+        for coll in [self.layers, *[g.layers for g in self.groups.values()]]:
+            for layer in coll.values():
+                if hasattr(layer, "buffer_specs"):
+                    buffers.update(layer.buffer_specs())
+        return buffers
+
+    def lr_scales(self, params: Dict[str, jax.Array]) -> Dict[str, float]:
+        """Per-parameter learning-rate scale (ParameterConfig.learning_rate);
+        0 for static parameters."""
+        return {
+            n: 0.0 if n in self.static_params
+            else self.param_specs[n].learning_rate
+            for n in params
+        }
+
+    # ------------------------------------------------------------ forward
+    def forward(self, params: Dict[str, jax.Array], feed: Dict[str, Any],
+                buffers: Optional[Dict[str, jax.Array]] = None,
+                is_training: bool = True,
+                rng: Optional[jax.Array] = None
+                ) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
+        """Run all layers; returns (all outputs by name, updated buffers)."""
+        ctx = ForwardContext(is_training=is_training, rng=rng,
+                             buffers=buffers or {})
+        values: Dict[str, Any] = {}
+        done_groups: Set[str] = set()
+        for name in self.order:
+            layer = self.layers[name]
+            if layer.conf.type == "data":
+                if name not in feed:
+                    raise ConfigError(f"missing feed for data layer {name!r}")
+                values[name] = feed[name]
+                continue
+            # run any recurrent group whose inputs are all ready lazily:
+            # groups appear in order via their output layers
+            with layer_stack.guard(name):
+                inputs = []
+                for iname in layer.conf.input_names():
+                    if iname not in values:
+                        self._run_producer(iname, params, values, ctx, done_groups)
+                    inputs.append(values[iname])
+                out = layer.forward(params, inputs, ctx)
+            if isinstance(out, dict):
+                for k, v in out.items():
+                    values[name if k == "out" else f"{name}.{k}"] = v
+            else:
+                values[name] = out
+        ctx.buffers.update(ctx.new_buffers)
+        return values, ctx.buffers
+
+    def _run_producer(self, name: str, params, values, ctx, done_groups):
+        """Produce a value coming from a recurrent-group output link."""
+        group_name = self.group_of.get(name)
+        if group_name is None or group_name in done_groups:
+            raise ConfigError(f"layer input {name!r} has no producer")
+        group = self.groups.get(group_name)
+        if group is None:
+            raise ConfigError(
+                f"generating group {group_name!r} must run via generate()")
+        group.run(params, values, ctx)
+        done_groups.add(group_name)
+
+    # --------------------------------------------------------------- loss
+    def loss(self, params: Dict[str, jax.Array], feed: Dict[str, Any],
+             buffers: Optional[Dict[str, jax.Array]] = None,
+             is_training: bool = True, rng: Optional[jax.Array] = None
+             ) -> Tuple[jax.Array, Tuple[Dict[str, Any], Dict[str, jax.Array]]]:
+        """Scalar objective = mean per-example total cost (TrainerInternal
+        ``Argument::sum`` / batchSize convention)."""
+        values, new_buffers = self.forward(params, feed, buffers,
+                                           is_training, rng)
+        enforce(self.cost_layers, "network has no cost layer")
+        total = None
+        for cname in self.cost_layers:
+            out = values[cname]
+            v = value_of(out)
+            c = jnp.sum(v) / v.shape[0]
+            total = c if total is None else total + c
+        return total, (values, new_buffers)
+
+    def outputs(self, values: Dict[str, Any]) -> Dict[str, Any]:
+        return {n: values[n] for n in self.output_names if n in values}

@@ -1,0 +1,139 @@
+"""Recurrent layer groups — the ``RecurrentGradientMachine`` equivalent.
+
+Reference: ``paddle/gserver/gradientmachines/RecurrentGradientMachine.cpp``
+runs a sub-``ModelConfig`` once per timestep over variable-length sequences,
+wiring ScatterAgent/GatherAgent layers for frame I/O and "memory" links that
+feed a layer's frame-``t`` output into frame ``t+1``
+(``config_parser.py:367`` RecurrentLayerGroupBegin).
+
+TPU-first re-design: the per-step sub-network is **traced once** and driven
+by ``lax.scan`` over the padded time axis.  Memories are scan carries;
+in-links are scanned inputs; out-links are stacked scan outputs.  Masking
+freezes carries past each sequence's length, reproducing the reference's
+variable-length semantics without dynamic shapes.  Beam-search generation
+lives in :mod:`paddle_tpu.layers.beam_search` as a ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.model_config import ModelConfig, SubModelConfig
+from ..core.sequence import SequenceBatch, value_of
+from ..utils import ConfigError, enforce, layer_stack
+from .base import LAYERS, ForwardContext, Layer
+
+
+class RecurrentGroup:
+    """Executes one SubModelConfig with lax.scan."""
+
+    def __init__(self, sub: SubModelConfig, model: ModelConfig):
+        self.sub = sub
+        self.model = model
+        self.layers: Dict[str, Layer] = {}
+        self.order: List[str] = []
+        lmap = model.layer_map()
+        for ln in sub.layer_names:
+            conf = lmap[ln]
+            if conf.type == "data":
+                continue
+            self.layers[ln] = LAYERS.get(conf.type)(conf, model)
+            self.order.append(ln)
+        self.in_links = list(sub.in_links)
+        self.out_links = list(sub.out_links)
+        self.memories = list(sub.memories)
+
+    def _memory_init(self, mem: Dict[str, Any], values: Dict[str, Any],
+                     batch: int, dtype) -> jax.Array:
+        boot = mem.get("boot_layer_name")
+        if boot:
+            return value_of(values[boot])
+        size = mem.get("size", 0)
+        if not size:
+            size = self.model.find_layer(mem["layer_name"]).size
+        init = jnp.zeros((batch, size), dtype)
+        bias = mem.get("boot_bias")
+        if bias is not None:
+            init = init + bias
+        return init
+
+    def step(self, params: Dict[str, jax.Array], frame: Dict[str, Any],
+             mems: List[jax.Array], outer: Dict[str, Any],
+             ctx: ForwardContext) -> Tuple[List[jax.Array], Dict[str, Any]]:
+        """One timestep: returns (new memory values, all step outputs)."""
+        values: Dict[str, Any] = dict(frame)
+        for mem, mval in zip(self.memories, mems):
+            values[mem.get("link_name", mem["layer_name"] + "@pre")] = mval
+        for name in self.order:
+            layer = self.layers[name]
+            with layer_stack.guard(name + "@" + self.sub.name):
+                inputs = []
+                for iname in layer.conf.input_names():
+                    if iname in values:
+                        inputs.append(values[iname])
+                    elif iname in outer:  # static (read-only) outer input
+                        inputs.append(outer[iname])
+                    else:
+                        raise ConfigError(
+                            f"group {self.sub.name}: input {iname!r} not found")
+                out = layer.forward(params, inputs, ctx)
+            if isinstance(out, dict):
+                for k, v in out.items():
+                    values[name if k == "out" else f"{name}.{k}"] = v
+            else:
+                values[name] = out
+        new_mems = [value_of(values[m["layer_name"]]) for m in self.memories]
+        return new_mems, values
+
+    def run(self, params: Dict[str, jax.Array], values: Dict[str, Any],
+            ctx: ForwardContext) -> None:
+        """Scan the group over its in-link sequences; writes out-link
+        sequences into ``values``."""
+        enforce(self.in_links, f"group {self.sub.name} has no in_links")
+        seqs = []
+        for l in self.in_links:
+            s = values[l]
+            enforce(isinstance(s, SequenceBatch),
+                    f"in_link {l!r} must be a sequence")
+            seqs.append(s)
+        t = seqs[0].max_len
+        b = seqs[0].batch_size
+        length = seqs[0].length
+        mask = seqs[0].mask(jnp.float32)  # [B, T]
+        dtype = seqs[0].data.dtype
+
+        mems0 = [self._memory_init(m, values, b, jnp.float32)
+                 for m in self.memories]
+
+        # scanned inputs: [T, B, ...]
+        xs = {l: jnp.moveaxis(s.data, 1, 0) for l, s in zip(self.in_links, seqs)}
+        m_t = jnp.moveaxis(mask, 1, 0)
+        if self.sub.reversed:
+            xs = {k: v[::-1] for k, v in xs.items()}
+            m_t = m_t[::-1]
+
+        outer = values
+
+        def scan_fn(carry, inp):
+            mems = carry
+            frame_inputs = {l: inp[l] for l in self.in_links}
+            m = inp["__mask__"][:, None]
+            new_mems, step_vals = self.step(params, frame_inputs, mems,
+                                            outer, ctx)
+            kept = [m * nm + (1 - m) * om for nm, om in zip(new_mems, mems)]
+            outs = {o: value_of(step_vals[o]) * \
+                    m.reshape((b,) + (1,) * (value_of(step_vals[o]).ndim - 1))
+                    for o in self.out_links}
+            return kept, outs
+
+        inp = dict(xs)
+        inp["__mask__"] = m_t
+        _, stacked = jax.lax.scan(scan_fn, mems0, inp)
+        for o in self.out_links:
+            data = jnp.moveaxis(stacked[o], 0, 1)  # [B, T, ...]
+            if self.sub.reversed:
+                data = data[:, ::-1]
+            values[o] = SequenceBatch(data=data, length=length)

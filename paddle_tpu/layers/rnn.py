@@ -1,0 +1,159 @@
+"""Recurrent layers: lstmemory, grumemory, recurrent, and step variants.
+
+Reference: ``LstmLayer`` (type ``lstmemory``, with peephole "check" weights —
+``paddle/gserver/layers/LstmLayer.cpp``), ``GatedRecurrentLayer``
+(``gated_recurrent``), ``RecurrentLayer`` (``recurrent``), ``MDLstmLayer``
+(not ported — 2-D LSTM, rarely used), plus step layers ``lstm_step`` /
+``gru_step`` used inside recurrent groups.
+
+Convention parity: like the reference, ``lstmemory`` expects its input
+already projected to 4H by an upstream fc/mixed layer (the v1 DSL's
+``lstmemory`` wraps exactly that); ``gated_recurrent`` expects 3H.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops import recurrent_ops
+from ..ops.recurrent_ops import LstmState
+from ..utils import ConfigError, enforce
+from .base import ForwardContext, Layer, register_layer
+
+
+@register_layer("lstmemory")
+class LstmLayer(Layer):
+    """Input: sequence of [B, T, 4H] pre-projected gates; output [B, T, H].
+
+    Parameters: recurrent weight [H, 4H], bias [7H] = 4H gate bias + 3H
+    peephole checks (reference bias layout in LstmLayer.cpp).
+    """
+
+    def param_specs(self):
+        h = self.conf.size
+        specs = [self._weight_spec(0, (h, 4 * h), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((7 * h,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        seq = inputs[0]
+        enforce(isinstance(seq, SequenceBatch), "lstmemory needs sequence input")
+        h = self.conf.size
+        w_hh = params[self.weight_name(0)]
+        bias = params.get(self.bias_name()) if self.conf.with_bias else None
+        gate_bias = check_i = check_f = check_o = None
+        if bias is not None:
+            gate_bias = bias[: 4 * h]
+            check_i = bias[4 * h: 5 * h]
+            check_f = bias[5 * h: 6 * h]
+            check_o = bias[6 * h: 7 * h]
+        out, _ = recurrent_ops.lstm_sequence(
+            seq, None, w_hh, gate_bias, check_i, check_f, check_o,
+            reverse=self.conf.attrs.get("reversed", False),
+            gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
+            cell_act=self.conf.attrs.get("active_state_type", "tanh"),
+            out_act=self.conf.active_type or "tanh")
+        return out
+
+
+@register_layer("gated_recurrent", "grumemory")
+class GatedRecurrentLayer(Layer):
+    """Input [B, T, 3H] pre-projected; recurrent weight [H, 3H]."""
+
+    def param_specs(self):
+        h = self.conf.size
+        specs = [self._weight_spec(0, (h, 3 * h), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((3 * h,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        seq = inputs[0]
+        enforce(isinstance(seq, SequenceBatch), "grumemory needs sequence input")
+        h = self.conf.size
+        out, _ = recurrent_ops.gru_sequence(
+            seq, None, params[self.weight_name(0)],
+            params.get(self.bias_name()) if self.conf.with_bias else None,
+            reverse=self.conf.attrs.get("reversed", False),
+            gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
+            act=self.conf.active_type or "tanh")
+        return out
+
+
+@register_layer("recurrent")
+class RecurrentLayer(Layer):
+    """Simple recurrence over a pre-projected sequence (``RecurrentLayer``)."""
+
+    def param_specs(self):
+        h = self.conf.size
+        specs = [self._weight_spec(0, (h, h), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((h,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        seq = inputs[0]
+        out, _ = recurrent_ops.simple_rnn(
+            seq, params[self.weight_name(0)],
+            params.get(self.bias_name()) if self.conf.with_bias else None,
+            reverse=self.conf.attrs.get("reversed", False),
+            act=self.conf.active_type or "tanh")
+        return out
+
+
+@register_layer("lstm_step")
+class LstmStepLayer(Layer):
+    """Single LSTM step for recurrent groups (``LstmStepLayer``).
+
+    Inputs: [0] projected gates [B, 4H]; [1] prev state c [B, H] (as the
+    second output convention).  Output: h; cell state exposed via attrs.
+    """
+
+    def param_specs(self):
+        h = self.conf.size
+        specs = [self._weight_spec(0, (h, 4 * h), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((7 * h,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        h_prev = value_of(inputs[1])
+        c_prev = value_of(inputs[2])
+        h = self.conf.size
+        bias = params.get(self.bias_name()) if self.conf.with_bias else None
+        gb = ci = cf = co = None
+        if bias is not None:
+            gb, ci, cf, co = (bias[:4 * h], bias[4 * h:5 * h],
+                              bias[5 * h:6 * h], bias[6 * h:7 * h])
+            x = x + gb
+        state, out = recurrent_ops.lstm_gate_step(
+            x, LstmState(h=h_prev, c=c_prev), params[self.weight_name(0)],
+            ci, cf, co)
+        # expose (h, c); network stores tuple outputs by name suffix
+        return {"out": like(inputs[0], out), "state": like(inputs[0], state.c)}
+
+
+@register_layer("gru_step")
+class GruStepLayer(Layer):
+    def param_specs(self):
+        h = self.conf.size
+        specs = [self._weight_spec(0, (h, 3 * h), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((3 * h,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        h_prev = value_of(inputs[1])
+        bias = params.get(self.bias_name()) if self.conf.with_bias else None
+        if bias is not None:
+            x = x + bias
+        out = recurrent_ops.gru_unit(
+            x, h_prev, params[self.weight_name(0)],
+            gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
+            act=self.conf.active_type or "tanh")
+        return like(inputs[0], out)

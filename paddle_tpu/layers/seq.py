@@ -1,0 +1,223 @@
+"""Sequence layers.
+
+Reference: ``SequencePoolLayer`` family (types ``average``, ``max``,
+``seqlastins``, ``seqfirstins``), ``ExpandLayer`` (``expand``),
+``SequenceConcatLayer`` (``seqconcat``), ``SequenceReshapeLayer``
+(``seqreshape``), ``SequenceSliceLayer`` (``seq_slice``), ``SubSequenceLayer``
+(``subseq``), ``KmaxSeqScoreLayer`` (``kmax_seq_score``),
+``SequenceLastInstanceLayer``, ``MaxIdLayer`` (``maxid``),
+``SamplingIdLayer`` (``sampling_id``), ``EosIdCheckLayer`` (``eos_id``),
+``GetOutputLayer``, ``SequenceToBatch`` scheduling is obsolete on TPU (the
+padded layout + masks replace it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.sequence import NestedSequenceBatch, SequenceBatch, like, value_of
+from ..ops import embedding_ops, sequence_ops
+from ..utils import ConfigError, enforce
+from .base import ForwardContext, Layer, register_layer
+
+
+def _as_seq(x) -> SequenceBatch:
+    enforce(isinstance(x, (SequenceBatch, NestedSequenceBatch)),
+            "layer requires a sequence input")
+    return x
+
+
+class _PoolBase(Layer):
+    pool_type = "average"
+
+    def forward(self, params, inputs, ctx):
+        seq = _as_seq(inputs[0])
+        stride = self.conf.attrs.get("stride", -1)
+        if stride > 0:
+            # strided pooling: pool over windows of `stride` timesteps,
+            # producing a shorter sequence (reference seqlastins w/ stride)
+            seq = _strided_reshape(seq, stride)
+            pooled = jax.vmap(
+                lambda d, l: _pool_window(d, l, self.pool_type))(
+                    seq.data, seq.sub_length)
+            return SequenceBatch(data=pooled, length=seq.num_subseq)
+        if isinstance(seq, NestedSequenceBatch):
+            # pool the inner level → sequence of per-subseq vectors
+            flat = seq.flatten_to_subseq()
+            pooled = sequence_ops.sequence_pool(flat, self.pool_type)
+            b, s = seq.data.shape[:2]
+            return SequenceBatch(
+                data=pooled.reshape((b, s) + pooled.shape[1:]),
+                length=seq.num_subseq)
+        out = sequence_ops.sequence_pool(seq, self.pool_type)
+        return self.finalize(out, ctx)
+
+
+def _pool_window(data, lengths, pool_type):
+    sb = SequenceBatch(data=data, length=lengths)
+    return sequence_ops.sequence_pool(sb, pool_type)
+
+
+def _strided_reshape(seq: SequenceBatch, stride: int) -> NestedSequenceBatch:
+    b, t = seq.data.shape[:2]
+    n = (t + stride - 1) // stride
+    pad = n * stride - t
+    data = jnp.pad(seq.data, [(0, 0), (0, pad)] + [(0, 0)] * (seq.data.ndim - 2))
+    data = data.reshape((b, n, stride) + seq.data.shape[2:])
+    starts = jnp.arange(n, dtype=jnp.int32)[None, :] * stride
+    sub_len = jnp.clip(seq.length[:, None] - starts, 0, stride)
+    num_sub = (seq.length + stride - 1) // stride
+    return NestedSequenceBatch(data=data, num_subseq=num_sub, sub_length=sub_len)
+
+
+@register_layer("average")
+class AverageLayer(_PoolBase):
+    @property
+    def pool_type(self):
+        t = self.conf.attrs.get("average_strategy", "average")
+        return {"average": "average", "sum": "sum", "squarerootn": "sqrt"}.get(t, "average")
+
+
+@register_layer("max")
+class MaxPoolSeqLayer(_PoolBase):
+    pool_type = "max"
+
+
+@register_layer("seqlastins")
+class SequenceLastInstanceLayer(_PoolBase):
+    pool_type = "last"
+
+
+@register_layer("seqfirstins")
+class SequenceFirstInstanceLayer(_PoolBase):
+    pool_type = "first"
+
+
+@register_layer("expand")
+class ExpandLayer(Layer):
+    """Broadcast non-sequence rows over the time axis of the second input."""
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        template = _as_seq(inputs[1])
+        if isinstance(template, NestedSequenceBatch):
+            t = template.data.shape[1]
+            data = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+            return SequenceBatch(data=data, length=template.num_subseq)
+        return sequence_ops.seq_expand(x, template)
+
+
+@register_layer("seqconcat")
+class SequenceConcatLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return sequence_ops.sequence_concat(_as_seq(inputs[0]), _as_seq(inputs[1]))
+
+
+@register_layer("seqreshape")
+class SequenceReshapeLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return sequence_ops.sequence_reshape(_as_seq(inputs[0]), self.conf.size)
+
+
+@register_layer("seq_slice")
+class SequenceSliceLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        seq = _as_seq(inputs[0])
+        offsets = value_of(inputs[1]).reshape(-1).astype(jnp.int32) \
+            if len(inputs) > 1 else jnp.zeros_like(seq.length)
+        sizes = value_of(inputs[2]).reshape(-1).astype(jnp.int32) \
+            if len(inputs) > 2 else seq.length - offsets
+        return sequence_ops.sequence_slice(seq, offsets, sizes)
+
+
+@register_layer("subseq")
+class SubSequenceLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        seq = _as_seq(inputs[0])
+        offsets = value_of(inputs[1]).reshape(-1).astype(jnp.int32)
+        sizes = value_of(inputs[2]).reshape(-1).astype(jnp.int32)
+        return sequence_ops.sequence_slice(seq, offsets, sizes)
+
+
+@register_layer("sub_nested_seq")
+class SubNestedSequenceLayer(Layer):
+    """Select subsequences of a nested sequence by per-sequence indices
+    (``SubNestedSequenceLayer``)."""
+
+    def forward(self, params, inputs, ctx):
+        nested = inputs[0]
+        enforce(isinstance(nested, NestedSequenceBatch),
+                "sub_nested_seq needs a nested sequence")
+        sel = value_of(inputs[1]).astype(jnp.int32)  # [B, K] indices, -1 pad
+        k = sel.shape[1]
+        safe = jnp.maximum(sel, 0)
+        data = jnp.take_along_axis(
+            nested.data,
+            safe.reshape(safe.shape + (1,) * (nested.data.ndim - 2)), axis=1)
+        sub_len = jnp.take_along_axis(nested.sub_length, safe, axis=1)
+        valid = sel >= 0
+        sub_len = jnp.where(valid, sub_len, 0)
+        return NestedSequenceBatch(
+            data=data, num_subseq=jnp.sum(valid.astype(jnp.int32), axis=1),
+            sub_length=sub_len)
+
+
+@register_layer("kmax_seq_score")
+class KmaxSeqScoreLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        seq = _as_seq(inputs[0])
+        return like(seq, sequence_ops.kmax_seq_score(
+            seq, self.conf.attrs.get("beam_size", 1)))
+
+
+@register_layer("maxid")
+class MaxIdLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        out = sequence_ops.max_id(value_of(x),
+                                  self.conf.attrs.get("beam_size", 1))
+        return like(x, out)
+
+
+@register_layer("sampling_id")
+class SamplingIdLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        out = embedding_ops.sampling_id(
+            ctx.layer_rng(self.name), value_of(inputs[0]))
+        return like(inputs[0], out)
+
+
+@register_layer("eos_id")
+class EosIdCheckLayer(Layer):
+    """1 where input id == eos_id (``EosIdCheckLayer``)."""
+
+    def forward(self, params, inputs, ctx):
+        ids = value_of(inputs[0])
+        eos = self.conf.attrs["eos_id"]
+        return like(inputs[0], (ids == eos).astype(jnp.float32))
+
+
+@register_layer("get_output")
+class GetOutputLayer(Layer):
+    """Pass-through selecting a named output of the input layer
+    (``GetOutputLayer``) — outputs here are single-valued, so identity."""
+
+    def forward(self, params, inputs, ctx):
+        return inputs[0]
+
+
+@register_layer("gather_agent")
+class GatherAgentLayer(Layer):
+    """Recurrent-group plumbing: concatenates per-step frames back into a
+    sequence.  Executed implicitly by the TPU recurrent-group scan
+    (:mod:`paddle_tpu.layers.recurrent_group`); standalone use is identity."""
+
+    def forward(self, params, inputs, ctx):
+        return inputs[0]
+
+
+@register_layer("scatter_agent")
+class ScatterAgentLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        return inputs[0]

@@ -74,7 +74,10 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     """
     b, t, _ = seq.data.shape
     h_dim = w_hh.shape[0]
-    xw = matmul(seq.data.reshape(b * t, -1), w_ih).reshape(b, t, 4 * h_dim)
+    if w_ih is None:  # input already projected to 4H (lstmemory convention)
+        xw = seq.data
+    else:
+        xw = matmul(seq.data.reshape(b * t, -1), w_ih).reshape(b, t, 4 * h_dim)
     if bias is not None:
         xw = xw + bias
     mask = seq.mask(xw.dtype)  # [B, T]
@@ -114,7 +117,10 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
     """
     b, t, _ = seq.data.shape
     h_dim = w_hh.shape[0]
-    xw = matmul(seq.data.reshape(b * t, -1), w_ih).reshape(b, t, 3 * h_dim)
+    if w_ih is None:  # input already projected to 3H (grumemory convention)
+        xw = seq.data
+    else:
+        xw = matmul(seq.data.reshape(b * t, -1), w_ih).reshape(b, t, 3 * h_dim)
     if bias is not None:
         xw = xw + bias
     mask = seq.mask(xw.dtype)

@@ -1,0 +1,119 @@
+"""Finite-difference gradient checker for layers.
+
+Port of the reference's core layer-correctness tool
+(``paddle/gserver/tests/LayerGradUtil.h`` ``testLayerGrad:306``): build a
+one-layer network from a programmatic config, attach a scalar objective, and
+compare autodiff gradients of every parameter and input against central
+finite differences.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.config.model_config import (
+    LayerConfig,
+    LayerInput,
+    ModelConfig,
+    ProjConfig,
+)
+from paddle_tpu.core.sequence import SequenceBatch, value_of
+from paddle_tpu.layers import NeuralNetwork
+
+
+def build_single_layer_net(layer_type: str, *, size: int,
+                           input_sizes: List[int],
+                           input_types: Optional[List[str]] = None,
+                           active_type: str = "",
+                           with_bias: bool = False,
+                           attrs: Optional[Dict[str, Any]] = None,
+                           projs: Optional[List[Optional[ProjConfig]]] = None
+                           ) -> NeuralNetwork:
+    layers = []
+    inputs = []
+    input_types = input_types or ["dense"] * len(input_sizes)
+    for i, (isz, ityp) in enumerate(zip(input_sizes, input_types)):
+        layers.append(LayerConfig(name=f"in{i}", type="data", size=isz))
+        proj = projs[i] if projs else None
+        inputs.append(LayerInput(input_layer_name=f"in{i}", proj=proj))
+    layers.append(LayerConfig(
+        name="test", type=layer_type, size=size, inputs=inputs,
+        active_type=active_type, with_bias=with_bias, attrs=attrs or {}))
+    return NeuralNetwork(ModelConfig(
+        layers=layers, input_layer_names=[f"in{i}" for i in range(len(input_sizes))],
+        output_layer_names=["test"]))
+
+
+def scalar_loss(net: NeuralNetwork, params, feed):
+    values, _ = net.forward(params, feed, is_training=False)
+    out = value_of(values["test"])
+    if isinstance(values["test"], SequenceBatch):
+        mask = values["test"].mask(jnp.float32)
+        mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+        out = out * mask
+    # quadratic readout makes the objective sensitive everywhere
+    return jnp.sum(out * jnp.cos(0.1 * jnp.arange(out.size, dtype=out.dtype)
+                                 .reshape(out.shape)))
+
+
+def check_layer_grad(net: NeuralNetwork, feed: Dict[str, Any],
+                     eps: float = 1e-3, rtol: float = 2e-2,
+                     atol: float = 1e-4, check_inputs: bool = True,
+                     seed: int = 3) -> None:
+    params = net.init_params(seed)
+    # randomize zero-init biases so gradients aren't trivially symmetric
+    params = {k: v + 0.01 * jnp.asarray(
+        np.random.RandomState(1).randn(*v.shape), jnp.float32)
+        for k, v in params.items()}
+
+    loss_fn = lambda p, f: scalar_loss(net, p, f)
+    grads = jax.grad(loss_fn)(params, feed)
+
+    for name, g in grads.items():
+        p = params[name]
+        flat_idx = np.random.RandomState(7).choice(
+            p.size, size=min(8, p.size), replace=False)
+        for idx in flat_idx:
+            unit = np.zeros(p.size, np.float32)
+            unit[idx] = eps
+            unit = unit.reshape(p.shape)
+            lp = float(loss_fn({**params, name: p + unit}, feed))
+            lm = float(loss_fn({**params, name: p - unit}, feed))
+            fd = (lp - lm) / (2 * eps)
+            ag = float(np.asarray(g).reshape(-1)[idx])
+            np.testing.assert_allclose(
+                ag, fd, rtol=rtol, atol=atol,
+                err_msg=f"param {name}[{idx}] grad mismatch")
+
+    if not check_inputs:
+        return
+    for fname, fval in feed.items():
+        data = value_of(fval)
+        if not jnp.issubdtype(data.dtype, jnp.floating):
+            continue
+
+        def loss_wrt_input(d):
+            if isinstance(fval, SequenceBatch):
+                f2 = {**feed, fname: SequenceBatch(data=d, length=fval.length)}
+            else:
+                f2 = {**feed, fname: d}
+            return loss_fn(params, f2)
+
+        g = jax.grad(loss_wrt_input)(data)
+        flat_idx = np.random.RandomState(11).choice(
+            data.size, size=min(6, data.size), replace=False)
+        for idx in flat_idx:
+            unit = np.zeros(data.size, np.float32)
+            unit[idx] = eps
+            unit = unit.reshape(data.shape)
+            lp = float(loss_wrt_input(data + unit))
+            lm = float(loss_wrt_input(data - unit))
+            fd = (lp - lm) / (2 * eps)
+            ag = float(np.asarray(g).reshape(-1)[idx])
+            np.testing.assert_allclose(
+                ag, fd, rtol=rtol, atol=atol,
+                err_msg=f"input {fname}[{idx}] grad mismatch")

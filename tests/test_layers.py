@@ -1,0 +1,290 @@
+"""Layer-engine tests: construction, forward shapes, gradient checks.
+
+The FD gradient checker mirrors ``test_LayerGrad.cpp``; the end-to-end MLP
+mirrors the minimum slice of ``test_TrainerOnePass.cpp``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from layer_grad_util import build_single_layer_net, check_layer_grad, scalar_loss
+from paddle_tpu.config.model_config import (
+    LayerConfig,
+    LayerInput,
+    ModelConfig,
+    ProjConfig,
+    SubModelConfig,
+)
+from paddle_tpu.core.sequence import SequenceBatch, pad_batch
+from paddle_tpu.layers import LAYERS, NeuralNetwork
+
+
+def _dense(rng, b, d):
+    return jnp.asarray(rng.randn(b, d).astype(np.float32))
+
+
+def _seq(rng, lens, d):
+    return pad_batch([rng.randn(l, d).astype(np.float32) for l in lens])
+
+
+def test_layer_registry_coverage():
+    expected = [
+        "data", "fc", "embedding", "mixed", "addto", "concat", "selective_fc",
+        "interpolation", "out_prod", "power", "scaling", "slope_intercept",
+        "convex_comb", "cos", "cos_vm", "sum_to_one_norm", "row_l2_norm",
+        "trans", "resize", "clip", "scale_shift", "prelu", "multiplex",
+        "dot_prod", "featmap_expand", "tensor", "nce", "hsigmoid",
+        "data_norm", "print", "exconv", "exconvt", "pool", "norm",
+        "batch_norm", "maxout", "blockexpand", "spp", "pad", "crop",
+        "rotate", "switch_order", "bilinear_interp", "average", "max",
+        "seqlastins", "seqfirstins", "expand", "seqconcat", "seqreshape",
+        "seq_slice", "subseq", "sub_nested_seq", "kmax_seq_score", "maxid",
+        "sampling_id", "eos_id", "get_output", "gather_agent",
+        "scatter_agent", "lstmemory", "gated_recurrent", "recurrent",
+        "lstm_step", "gru_step", "multi-class-cross-entropy",
+        "square_error", "rank-cost", "lambda_cost",
+        "multi_binary_label_cross_entropy", "huber_regression",
+        "huber_classification", "smooth_l1", "sum_cost", "crf",
+        "crf_decoding", "ctc", "soft_binary_class_cross_entropy",
+        "multi_class_cross_entropy_with_selfnorm",
+    ]
+    for name in expected:
+        assert name in LAYERS, f"layer type {name} not registered"
+
+
+def test_fc_layer_grad(rng):
+    net = build_single_layer_net("fc", size=6, input_sizes=[4],
+                                 active_type="tanh", with_bias=True)
+    check_layer_grad(net, {"in0": _dense(rng, 3, 4)})
+
+
+def test_fc_multi_input_grad(rng):
+    net = build_single_layer_net("fc", size=5, input_sizes=[4, 3],
+                                 active_type="sigmoid", with_bias=True)
+    check_layer_grad(net, {"in0": _dense(rng, 2, 4), "in1": _dense(rng, 2, 3)})
+
+
+def test_fc_on_sequence(rng):
+    net = build_single_layer_net("fc", size=6, input_sizes=[4],
+                                 active_type="relu")
+    sb = _seq(rng, [3, 5], 4)
+    params = net.init_params()
+    values, _ = net.forward(params, {"in0": sb})
+    out = values["test"]
+    assert isinstance(out, SequenceBatch)
+    assert out.data.shape == (2, sb.max_len, 6)
+
+
+def test_mixed_projections_grad(rng):
+    net = build_single_layer_net(
+        "mixed", size=6, input_sizes=[4, 6],
+        projs=[ProjConfig(type="fc", input_size=4, output_size=6),
+               ProjConfig(type="dot_mul", input_size=6, output_size=6)],
+        with_bias=True)
+    check_layer_grad(net, {"in0": _dense(rng, 3, 4), "in1": _dense(rng, 3, 6)})
+
+
+def test_mixed_context_projection(rng):
+    net = build_single_layer_net(
+        "mixed", size=12, input_sizes=[4],
+        projs=[ProjConfig(type="context", input_size=4, context_start=-1,
+                          context_length=3)])
+    sb = _seq(rng, [4, 2], 4)
+    values, _ = net.forward(net.init_params(), {"in0": sb})
+    assert values["test"].data.shape[-1] == 12
+
+
+def test_conv_layer_grad(rng):
+    net = build_single_layer_net(
+        "exconv", size=0, input_sizes=[3 * 5 * 5], active_type="relu",
+        with_bias=True,
+        attrs={"channels": 3, "filter_size": 3, "num_filters": 4,
+               "img_size": 5, "img_size_y": 5, "stride": 1, "padding": 1})
+    x = jnp.asarray(rng.randn(2, 3 * 5 * 5).astype(np.float32))
+    check_layer_grad(net, {"in0": x}, rtol=5e-2)
+
+
+def test_pool_layer_forward(rng):
+    net = build_single_layer_net(
+        "pool", size=0, input_sizes=[8 * 4 * 4],
+        attrs={"channels": 8, "pool_size": 2, "stride": 2, "img_size": 4,
+               "img_size_y": 4, "pool_type": "max-projection"})
+    x = jnp.asarray(rng.randn(2, 8 * 4 * 4).astype(np.float32))
+    values, _ = net.forward(net.init_params(), {"in0": x})
+    assert values["test"].shape == (2, 2, 2, 8)
+
+
+def test_batch_norm_buffers(rng):
+    net = build_single_layer_net(
+        "batch_norm", size=6, input_sizes=[6], with_bias=True,
+        attrs={"channels": 6})
+    params = net.init_params()
+    buffers = net.init_buffers()
+    assert "test.mean" in buffers
+    x = _dense(rng, 16, 6) * 2 + 1
+    values, new_buf = net.forward(params, {"in0": x}, buffers)
+    assert not np.allclose(np.asarray(new_buf["test.mean"]), 0)
+    # inference path uses buffers
+    values2, _ = net.forward(params, {"in0": x}, new_buf, is_training=False)
+    assert np.isfinite(np.asarray(values2["test"])).all()
+
+
+def test_lstmemory_grad(rng):
+    net = build_single_layer_net("lstmemory", size=3, input_sizes=[12],
+                                 with_bias=True)
+    sb = _seq(rng, [3, 2], 12)
+    check_layer_grad(net, {"in0": sb}, rtol=5e-2, atol=5e-4)
+
+
+def test_gated_recurrent_forward(rng):
+    net = build_single_layer_net("gated_recurrent", size=4, input_sizes=[12])
+    sb = _seq(rng, [3, 5], 12)
+    values, _ = net.forward(net.init_params(), {"in0": sb})
+    assert values["test"].data.shape == (2, sb.max_len, 4)
+
+
+def test_sequence_pool_layers_grad(rng):
+    for ltype in ["average", "max", "seqlastins", "seqfirstins"]:
+        net = build_single_layer_net(ltype, size=4, input_sizes=[4])
+        sb = _seq(rng, [3, 2], 4)
+        check_layer_grad(net, {"in0": sb}, check_inputs=True)
+
+
+def test_expand_layer(rng):
+    layers = [
+        LayerConfig(name="vec", type="data", size=3),
+        LayerConfig(name="seq", type="data", size=2),
+        LayerConfig(name="test", type="expand", size=3, inputs=[
+            LayerInput(input_layer_name="vec"),
+            LayerInput(input_layer_name="seq")]),
+    ]
+    net = NeuralNetwork(ModelConfig(layers=layers, output_layer_names=["test"]))
+    vec = _dense(rng, 2, 3)
+    sb = _seq(rng, [2, 4], 2)
+    values, _ = net.forward(net.init_params(), {"vec": vec, "seq": sb})
+    out = values["test"]
+    assert out.data.shape == (2, sb.max_len, 3)
+    np.testing.assert_allclose(np.asarray(out.data)[1, 3], np.asarray(vec)[1])
+
+
+def test_cost_layers_grad(rng):
+    # square_error
+    net = build_single_layer_net("square_error", size=1, input_sizes=[4, 4])
+    check_layer_grad(net, {"in0": _dense(rng, 3, 4), "in1": _dense(rng, 3, 4)})
+
+
+def test_classification_cost_pipeline(rng):
+    layers = [
+        LayerConfig(name="x", type="data", size=8),
+        LayerConfig(name="label", type="data", size=4),
+        LayerConfig(name="prob", type="fc", size=4, active_type="softmax",
+                    with_bias=True,
+                    inputs=[LayerInput(input_layer_name="x")]),
+        LayerConfig(name="cost", type="multi-class-cross-entropy", size=1,
+                    inputs=[LayerInput(input_layer_name="prob"),
+                            LayerInput(input_layer_name="label")]),
+    ]
+    net = NeuralNetwork(ModelConfig(layers=layers, output_layer_names=["cost"]))
+    params = net.init_params()
+    x = _dense(rng, 16, 8)
+    label = jnp.asarray(rng.randint(0, 4, 16))
+    loss, _ = net.loss(params, {"x": x, "label": label})
+    assert np.isfinite(float(loss))
+
+    # training reduces loss
+    from paddle_tpu.optimizer import SGD
+
+    opt = SGD(learning_rate=0.5)
+    st = opt.init_state(params)
+
+    @jax.jit
+    def step(p, s):
+        (l, _), g = jax.value_and_grad(
+            lambda p_: net.loss(p_, {"x": x, "label": label}), has_aux=True)(p)
+        p2, s2 = opt.apply(p, g, s)
+        return p2, s2, l
+
+    l0 = None
+    for i in range(30):
+        params, st, l = step(params, st)
+        if l0 is None:
+            l0 = float(l)
+    assert float(l) < l0 * 0.7, f"loss did not decrease: {l0} -> {float(l)}"
+
+
+def test_recurrent_group_matches_lstm_like(rng):
+    """A recurrent group computing h_t = tanh(x_t W + h_{t-1} U) must equal a
+    hand-rolled scan (config-equivalence test in the spirit of
+    test_RecurrentGradientMachine)."""
+    d, h = 3, 4
+    layers = [
+        LayerConfig(name="x", type="data", size=d),
+        LayerConfig(name="step_out", type="fc", size=h, active_type="tanh",
+                    inputs=[LayerInput(input_layer_name="x"),
+                            LayerInput(input_layer_name="h_pre")]),
+    ]
+    sub = SubModelConfig(
+        name="rnn_group", layer_names=["x", "step_out"],
+        in_links=["x"], out_links=["step_out"],
+        memories=[{"layer_name": "step_out", "link_name": "h_pre", "size": h}])
+    # an outer layer consuming the group output
+    layers.append(LayerConfig(name="pool", type="seqlastins", size=h,
+                              inputs=[LayerInput(input_layer_name="step_out")]))
+    net = NeuralNetwork(ModelConfig(
+        layers=layers, sub_models=[SubModelConfig(name="root"), sub],
+        output_layer_names=["pool"]))
+    params = net.init_params()
+    sb = _seq(rng, [4, 2], d)
+    values, _ = net.forward(params, {"x": sb})
+    out = values["step_out"]
+    assert out.data.shape == (2, sb.max_len, h)
+
+    w = np.asarray(params["_step_out.w0"])
+    u = np.asarray(params["_step_out.w1"])
+    x_np = np.asarray(sb.data)
+    for b, L in enumerate([4, 2]):
+        h_prev = np.zeros(h, np.float32)
+        for t in range(L):
+            h_prev = np.tanh(x_np[b, t] @ w + h_prev @ u)
+            np.testing.assert_allclose(
+                np.asarray(out.data)[b, t], h_prev, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(values["pool"])[b], h_prev, atol=1e-5)
+    # masked tail is zero
+    np.testing.assert_allclose(np.asarray(out.data)[1, 2:], 0.0)
+
+
+def test_recurrent_group_grad(rng):
+    d, h = 2, 3
+    layers = [
+        LayerConfig(name="x", type="data", size=d),
+        LayerConfig(name="step_out", type="fc", size=h, active_type="tanh",
+                    inputs=[LayerInput(input_layer_name="x"),
+                            LayerInput(input_layer_name="h_pre")]),
+        LayerConfig(name="test", type="seqlastins", size=h,
+                    inputs=[LayerInput(input_layer_name="step_out")]),
+    ]
+    sub = SubModelConfig(
+        name="g", layer_names=["x", "step_out"], in_links=["x"],
+        out_links=["step_out"],
+        memories=[{"layer_name": "step_out", "link_name": "h_pre", "size": h}])
+    net = NeuralNetwork(ModelConfig(
+        layers=layers, sub_models=[SubModelConfig(name="root"), sub],
+        output_layer_names=["test"]))
+    check_layer_grad(net, {"x": _seq(rng, [3, 2], d)}, rtol=5e-2)
+
+
+def test_shared_parameters():
+    layers = [
+        LayerConfig(name="x", type="data", size=4),
+        LayerConfig(name="a", type="fc", size=4, inputs=[
+            LayerInput(input_layer_name="x", input_parameter_name="shared_w")]),
+        LayerConfig(name="b", type="fc", size=4, inputs=[
+            LayerInput(input_layer_name="a", input_parameter_name="shared_w")]),
+    ]
+    net = NeuralNetwork(ModelConfig(layers=layers, output_layer_names=["b"]))
+    params = net.init_params()
+    assert "shared_w" in params
+    assert len([k for k in params if "w" in k]) == 1
