@@ -1,0 +1,1116 @@
+"""Layer-construction DSL → ModelConfig compiler.
+
+Port of the v1/v2 user API surface: ``trainer_config_helpers/layers.py``
+(131 layer functions compiled by ``config_parser.py``) and
+``python/paddle/v2/layer.py`` (same functions as graph nodes).  Functions
+here append :class:`LayerConfig` records to the active collector and return
+:class:`LayerOutput` handles; ``topology(outputs)`` extracts the reachable
+subgraph as a ModelConfig — the ``Topology.proto()`` equivalent
+(``v2/topology.py:95``).
+
+Naming parity: each function matches the reference DSL name (fc_layer is
+``fc``, img_conv_layer is ``img_conv``, etc. — the v2 names, which drop the
+``_layer`` suffix; v1 aliases are exported too).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from ..utils import ConfigError, enforce
+from .model_config import (
+    LayerConfig,
+    LayerInput,
+    ModelConfig,
+    OptimizationConfig,
+    ParameterConfig,
+    ProjConfig,
+    SubModelConfig,
+)
+
+# ----------------------------------------------------------- activations
+
+
+class Activation:
+    name = "linear"
+
+    def __init__(self, name: Optional[str] = None):
+        if name is not None:
+            self.name = name
+
+
+def _act_cls(act_name: str):
+    return type(act_name.title().replace("_", "") + "Activation",
+                (Activation,), {"name": act_name})
+
+
+LinearActivation = _act_cls("")
+ReluActivation = _act_cls("relu")
+BReluActivation = _act_cls("brelu")
+SigmoidActivation = _act_cls("sigmoid")
+TanhActivation = _act_cls("tanh")
+STanhActivation = _act_cls("stanh")
+SoftmaxActivation = _act_cls("softmax")
+SequenceSoftmaxActivation = _act_cls("sequence_softmax")
+ExpActivation = _act_cls("exp")
+LogActivation = _act_cls("log")
+SquareActivation = _act_cls("square")
+SqrtActivation = _act_cls("sqrt")
+ReciprocalActivation = _act_cls("reciprocal")
+AbsActivation = _act_cls("abs")
+SoftReluActivation = _act_cls("soft_relu")
+
+
+def _act_name(act) -> str:
+    if act is None:
+        return ""
+    if isinstance(act, str):
+        return act
+    return act.name
+
+
+# ------------------------------------------------------------ attributes
+
+
+@dataclass
+class ParamAttr:
+    """``attrs.py`` ParameterAttribute."""
+
+    name: Optional[str] = None
+    initial_mean: float = 0.0
+    initial_std: Optional[float] = None
+    learning_rate: float = 1.0
+    momentum: float = 0.0
+    l1_rate: float = 0.0
+    l2_rate: float = 0.0
+    is_static: bool = False
+    sparse_update: bool = False
+    initial_smart: bool = True
+
+
+@dataclass
+class ExtraAttr:
+    """ExtraLayerAttribute: drop_rate, device (→ sharding hint)."""
+
+    drop_rate: float = 0.0
+    device: int = -1
+
+
+# -------------------------------------------------------------- pooling
+
+
+class BasePoolingType:
+    name = "average"
+
+
+class MaxPooling(BasePoolingType):
+    name = "max"
+
+
+class AvgPooling(BasePoolingType):
+    name = "average"
+
+
+class SumPooling(BasePoolingType):
+    name = "sum"
+
+
+class SqrtPooling(BasePoolingType):
+    name = "sqrt"
+
+
+# -------------------------------------------------------- the collector
+
+
+class ConfigCollector(threading.local):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.layers: List[LayerConfig] = []
+        self.by_name: Dict[str, LayerConfig] = {}
+        self.parameters: List[ParameterConfig] = []
+        self.sub_models: List[SubModelConfig] = []
+        self.counter = 0
+        self.group_stack: List[SubModelConfig] = []
+
+    def unique_name(self, prefix: str) -> str:
+        self.counter += 1
+        return f"__{prefix}_{self.counter}__"
+
+    def add(self, conf: LayerConfig) -> LayerConfig:
+        if conf.name in self.by_name:
+            raise ConfigError(f"duplicate layer name {conf.name!r}")
+        self.layers.append(conf)
+        self.by_name[conf.name] = conf
+        if self.group_stack:
+            self.group_stack[-1].layer_names.append(conf.name)
+        return conf
+
+
+_collector = ConfigCollector()
+
+
+def reset_config() -> None:
+    _collector.reset()
+
+
+@dataclass
+class LayerOutput:
+    """Handle returned by every DSL function (v2 graph node)."""
+
+    name: str
+    layer_type: str
+    size: int = 0
+    # extra outputs (e.g. lstm step state) addressable as name.suffix
+    parents: List["LayerOutput"] = field(default_factory=list)
+
+    def __repr__(self):
+        return f"LayerOutput({self.name}, {self.layer_type}, size={self.size})"
+
+
+Input = Union[LayerOutput, Sequence[LayerOutput]]
+
+
+def _as_list(x) -> List[LayerOutput]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _mk_inputs(inputs: List[LayerOutput],
+               param_attrs: Optional[List[Optional[ParamAttr]]] = None,
+               projs: Optional[List[Optional[ProjConfig]]] = None
+               ) -> List[LayerInput]:
+    out = []
+    for i, li in enumerate(inputs):
+        pa = param_attrs[i] if param_attrs else None
+        out.append(LayerInput(
+            input_layer_name=li.name,
+            input_parameter_name=(pa.name if pa and pa.name else ""),
+            proj=projs[i] if projs else None))
+    return out
+
+
+def _register_param_attr(owner_name: str, pa: Optional[ParamAttr],
+                         idx: Optional[int], bias: bool = False) -> None:
+    """Record a ParameterConfig override from a ParamAttr."""
+    if pa is None:
+        return
+    name = pa.name or (f"_{owner_name}.wbias" if bias else f"_{owner_name}.w{idx}")
+    pc = ParameterConfig(
+        name=name,
+        learning_rate=pa.learning_rate,
+        momentum=pa.momentum,
+        decay_rate=pa.l2_rate,
+        decay_rate_l1=pa.l1_rate,
+        initial_mean=pa.initial_mean,
+        initial_std=pa.initial_std if pa.initial_std is not None else 0.01,
+        initial_smart=pa.initial_smart and pa.initial_std is None,
+        is_static=pa.is_static,
+        sparse_update=pa.sparse_update,
+    )
+    _collector.parameters.append(pc)
+
+
+def _bias_info(bias_attr) -> (bool, Optional[ParamAttr]):
+    if bias_attr is False:
+        return False, None
+    if bias_attr is None or bias_attr is True:
+        return True, None
+    return True, bias_attr
+
+
+def _extra(attrs: Dict[str, Any], layer_attr: Optional[ExtraAttr]
+           ) -> Dict[str, Any]:
+    return attrs
+
+
+def _add_layer(name: Optional[str], ltype: str, size: int,
+               inputs: List[LayerInput], act=None, bias_attr=False,
+               attrs: Optional[Dict[str, Any]] = None,
+               layer_attr: Optional[ExtraAttr] = None,
+               param_attrs: Optional[List[Optional[ParamAttr]]] = None
+               ) -> LayerOutput:
+    name = name or _collector.unique_name(ltype)
+    with_bias, bias_pa = _bias_info(bias_attr)
+    conf = LayerConfig(
+        name=name, type=ltype, size=size, active_type=_act_name(act),
+        inputs=inputs, with_bias=with_bias,
+        drop_rate=layer_attr.drop_rate if layer_attr else 0.0,
+        device=layer_attr.device if layer_attr else -1,
+        attrs=attrs or {})
+    _collector.add(conf)
+    if param_attrs:
+        for i, pa in enumerate(param_attrs):
+            _register_param_attr(name, pa, i)
+    if bias_pa:
+        _register_param_attr(name, bias_pa, None, bias=True)
+    return LayerOutput(name=name, layer_type=ltype, size=size)
+
+
+# ------------------------------------------------------------ data layer
+
+
+def data(name: str, type, height: int = 0, width: int = 0) -> LayerOutput:
+    """``data_layer``; ``type`` is a :class:`paddle_tpu.data.InputType`."""
+    conf = LayerConfig(name=name, type="data", size=type.dim,
+                       attrs={"height": height, "width": width,
+                              "seq_level": type.seq_level, "kind": type.kind})
+    _collector.add(conf)
+    return LayerOutput(name=name, layer_type="data", size=type.dim)
+
+
+data_layer = data
+
+
+# ----------------------------------------------------------- core layers
+
+
+def fc(input: Input, size: int, act=None, name: Optional[str] = None,
+       bias_attr=True, param_attr: Optional[ParamAttr] = None,
+       layer_attr: Optional[ExtraAttr] = None) -> LayerOutput:
+    ins = _as_list(input)
+    pas = [param_attr] * len(ins) if param_attr else None
+    return _add_layer(name, "fc", size, _mk_inputs(ins, pas), act,
+                      bias_attr, layer_attr=layer_attr, param_attrs=pas)
+
+
+fc_layer = fc
+
+
+def embedding(input: Input, size: int, name: Optional[str] = None,
+              param_attr: Optional[ParamAttr] = None,
+              vocab_size: Optional[int] = None,
+              sharded: bool = False) -> LayerOutput:
+    inp = _as_list(input)[0]
+    vocab = vocab_size or inp.size
+    pas = [param_attr] if param_attr else None
+    return _add_layer(None if name is None else name, "embedding", size,
+                      _mk_inputs([inp], pas),
+                      attrs={"vocab_size": vocab, "sharded": sharded},
+                      param_attrs=pas)
+
+
+embedding_layer = embedding
+
+
+def addto(input: Input, act=None, name: Optional[str] = None,
+          bias_attr=False, layer_attr=None) -> LayerOutput:
+    ins = _as_list(input)
+    return _add_layer(name, "addto", ins[0].size, _mk_inputs(ins), act,
+                      bias_attr, layer_attr=layer_attr)
+
+
+addto_layer = addto
+
+
+def concat(input: Input, act=None, name: Optional[str] = None,
+           layer_attr=None) -> LayerOutput:
+    ins = _as_list(input)
+    return _add_layer(name, "concat", sum(i.size for i in ins),
+                      _mk_inputs(ins), act, layer_attr=layer_attr)
+
+
+concat_layer = concat
+
+
+def dropout(input: Input, dropout_rate: float = 0.5,
+            name: Optional[str] = None) -> LayerOutput:
+    """v2 ``dropout`` = addto with drop_rate."""
+    return addto(input, name=name,
+                 layer_attr=ExtraAttr(drop_rate=dropout_rate))
+
+
+# ------------------------------------------------------------------ mixed
+
+
+def full_matrix_projection(input: LayerOutput, size: int,
+                           param_attr: Optional[ParamAttr] = None):
+    return (input, ProjConfig(type="fc", input_size=input.size,
+                              output_size=size), param_attr)
+
+
+def identity_projection(input: LayerOutput, offset: Optional[int] = None,
+                        size: Optional[int] = None):
+    if offset is not None:
+        end = offset + (size or input.size)
+        return (input, ProjConfig(type="slice", input_size=input.size,
+                                  slice_begin=offset, slice_end=end), None)
+    return (input, ProjConfig(type="identity", input_size=input.size,
+                              output_size=input.size), None)
+
+
+def dotmul_projection(input: LayerOutput,
+                      param_attr: Optional[ParamAttr] = None):
+    return (input, ProjConfig(type="dot_mul", input_size=input.size,
+                              output_size=input.size), param_attr)
+
+
+def scaling_projection(input: LayerOutput,
+                       param_attr: Optional[ParamAttr] = None):
+    return (input, ProjConfig(type="scaling", input_size=input.size,
+                              output_size=input.size), param_attr)
+
+
+def table_projection(input: LayerOutput, size: int,
+                     param_attr: Optional[ParamAttr] = None):
+    return (input, ProjConfig(type="table", input_size=input.size,
+                              output_size=size), param_attr)
+
+
+def context_projection(input: LayerOutput, context_len: int,
+                       context_start: Optional[int] = None,
+                       padding_attr=False):
+    start = context_start if context_start is not None \
+        else -(context_len // 2)
+    trainable = padding_attr is not False and padding_attr is not None
+    return (input, ProjConfig(type="context", input_size=input.size,
+                              context_start=start, context_length=context_len,
+                              trainable_padding=trainable),
+            padding_attr if trainable else None)
+
+
+def mixed(input=None, size: int = 0, name: Optional[str] = None, act=None,
+          bias_attr=False, layer_attr=None) -> LayerOutput:
+    """``mixed_layer``: input is a list of projection tuples."""
+    projs = _as_list(input)
+    ins, pcs, pas = [], [], []
+    for item in projs:
+        li, pc, pa = item
+        ins.append(li)
+        pcs.append(pc)
+        pas.append(pa)
+    if size == 0:
+        for pc in pcs:
+            if pc.output_size:
+                size = pc.output_size
+                break
+        else:
+            size = pcs[0].context_length * pcs[0].input_size
+    return _add_layer(name, "mixed", size, _mk_inputs(ins, pas, pcs), act,
+                      bias_attr, layer_attr=layer_attr, param_attrs=pas)
+
+
+mixed_layer = mixed
+
+
+# ------------------------------------------------------------------ image
+
+
+def img_conv(input: Input, filter_size: int, num_filters: int,
+             num_channels: Optional[int] = None, stride: int = 1,
+             padding: int = 1, groups: int = 1, act=None,
+             name: Optional[str] = None, bias_attr=True,
+             param_attr: Optional[ParamAttr] = None,
+             img_size: Optional[int] = None,
+             img_size_y: Optional[int] = None,
+             trans: bool = False, layer_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    img = img_size or int(round((inp.size / c) ** 0.5))
+    img_y = img_size_y or img
+    out_x = conv_out(img, filter_size, padding, stride)
+    out_y = conv_out(img_y, filter_size, padding, stride)
+    attrs = {"channels": c, "filter_size": filter_size,
+             "num_filters": num_filters, "stride": stride, "padding": padding,
+             "groups": groups, "img_size": img, "img_size_y": img_y,
+             "output_x": out_x, "output_y": out_y}
+    pas = [param_attr] if param_attr else None
+    out = _add_layer(name, "exconvt" if trans else "exconv",
+                     num_filters * out_x * out_y,
+                     _mk_inputs([inp], pas), act, bias_attr, attrs,
+                     layer_attr, pas)
+    out.channels = num_filters
+    out.img_size = out_x
+    out.img_size_y = out_y
+    return out
+
+
+img_conv_layer = img_conv
+
+
+def conv_out(img: int, filt: int, pad: int, stride: int) -> int:
+    return (img + 2 * pad - filt) // stride + 1
+
+
+def img_pool(input: Input, pool_size: int, num_channels: Optional[int] = None,
+             pool_type: Optional[BasePoolingType] = None, stride: int = 2,
+             padding: int = 0, name: Optional[str] = None,
+             img_size: Optional[int] = None, img_size_y: Optional[int] = None,
+             layer_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    img = img_size or getattr(inp, "img_size", int(round((inp.size / c) ** 0.5)))
+    img_y = img_size_y or getattr(inp, "img_size_y", img)
+    ptype = (pool_type or MaxPooling()).name
+    out_x = conv_out(img, pool_size, padding, stride)
+    out_y = conv_out(img_y, pool_size, padding, stride)
+    attrs = {"channels": c, "pool_size": pool_size, "stride": stride,
+             "padding": padding, "img_size": img, "img_size_y": img_y,
+             "pool_type": ptype + "-projection"}
+    out = _add_layer(name, "pool", c * out_x * out_y, _mk_inputs([inp]),
+                     None, False, attrs, layer_attr)
+    out.channels = c
+    out.img_size = out_x
+    out.img_size_y = out_y
+    return out
+
+
+img_pool_layer = img_pool
+
+
+def batch_norm(input: Input, act=None, name: Optional[str] = None,
+               num_channels: Optional[int] = None, bias_attr=True,
+               param_attr=None, use_global_stats: Optional[bool] = None,
+               moving_average_fraction: float = 0.9,
+               layer_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", inp.size)
+    attrs = {"channels": c,
+             "moving_average_fraction": moving_average_fraction}
+    if use_global_stats is not None:
+        attrs["use_global_stats"] = use_global_stats
+    if hasattr(inp, "img_size"):
+        attrs["img_size"] = inp.img_size
+        attrs["img_size_y"] = getattr(inp, "img_size_y", inp.img_size)
+    pas = [param_attr] if param_attr else None
+    out = _add_layer(name, "batch_norm", inp.size, _mk_inputs([inp], pas),
+                     act, bias_attr, attrs, layer_attr, pas)
+    for a in ("channels", "img_size", "img_size_y"):
+        if hasattr(inp, a):
+            setattr(out, a, getattr(inp, a))
+    out.channels = c
+    return out
+
+
+batch_norm_layer = batch_norm
+
+
+def img_cmrnorm(input: Input, size: int = 5, scale: float = 0.0128,
+                power: float = 0.75, name: Optional[str] = None,
+                num_channels: Optional[int] = None, layer_attr=None
+                ) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    attrs = {"channels": c, "norm_size": size, "scale": scale / size,
+             "pow": power,
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    out = _add_layer(name, "norm", inp.size, _mk_inputs([inp]), None, False,
+                     attrs, layer_attr)
+    for a in ("channels", "img_size", "img_size_y"):
+        if hasattr(inp, a):
+            setattr(out, a, getattr(inp, a))
+    return out
+
+
+img_cmrnorm_layer = img_cmrnorm
+
+
+def maxout(input: Input, groups: int, num_channels: Optional[int] = None,
+           name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    attrs = {"channels": c, "groups": groups,
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    out = _add_layer(name, "maxout", inp.size // groups, _mk_inputs([inp]),
+                     None, False, attrs)
+    out.channels = c // groups
+    return out
+
+
+maxout_layer = maxout
+
+
+def spp(input: Input, pyramid_height: int, num_channels: Optional[int] = None,
+        pool_type=None, name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    size = c * sum((2 ** i) ** 2 for i in range(pyramid_height))
+    attrs = {"channels": c, "pyramid_height": pyramid_height,
+             "pool_type": (pool_type or MaxPooling()).name,
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    return _add_layer(name, "spp", size, _mk_inputs([inp]), None, False, attrs)
+
+
+spp_layer = spp
+
+
+def bilinear_interp(input: Input, out_size_x: int, out_size_y: int,
+                    num_channels: Optional[int] = None,
+                    name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    attrs = {"channels": c, "out_size_x": out_size_x, "out_size_y": out_size_y,
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    out = _add_layer(name, "bilinear_interp", c * out_size_x * out_size_y,
+                     _mk_inputs([inp]), None, False, attrs)
+    out.channels = c
+    out.img_size = out_size_x
+    out.img_size_y = out_size_y
+    return out
+
+
+bilinear_interp_layer = bilinear_interp
+
+
+# -------------------------------------------------------------- recurrent
+
+
+def lstmemory(input: Input, name: Optional[str] = None, reverse: bool = False,
+              act=None, gate_act=None, state_act=None, bias_attr=True,
+              param_attr: Optional[ParamAttr] = None,
+              size: Optional[int] = None, layer_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    h = size or inp.size // 4
+    attrs = {"reversed": reverse,
+             "active_gate_type": _act_name(gate_act) or "sigmoid",
+             "active_state_type": _act_name(state_act) or "tanh"}
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "lstmemory", h, _mk_inputs([inp], pas),
+                      act or TanhActivation(), bias_attr, attrs, layer_attr,
+                      pas)
+
+
+def grumemory(input: Input, name: Optional[str] = None, reverse: bool = False,
+              act=None, gate_act=None, bias_attr=True,
+              param_attr: Optional[ParamAttr] = None,
+              size: Optional[int] = None, layer_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    h = size or inp.size // 3
+    attrs = {"reversed": reverse,
+             "active_gate_type": _act_name(gate_act) or "sigmoid"}
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "gated_recurrent", h, _mk_inputs([inp], pas),
+                      act or TanhActivation(), bias_attr, attrs, layer_attr,
+                      pas)
+
+
+def recurrent(input: Input, act=None, bias_attr=True,
+              param_attr: Optional[ParamAttr] = None, reverse: bool = False,
+              name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "recurrent", inp.size, _mk_inputs([inp], pas),
+                      act or TanhActivation(), bias_attr,
+                      {"reversed": reverse}, None, pas)
+
+
+recurrent_layer = recurrent
+
+
+# -------------------------------------------------- recurrent groups
+
+
+@dataclass
+class StepInput:
+    """Marks a sequence input scanned per-timestep inside a group."""
+
+    layer: LayerOutput
+
+
+class memory:
+    """``memory(name=..., size=...)`` inside a recurrent group step
+    (config_parser memory semantics: reads layer ``name``'s previous-step
+    output; optional boot layer)."""
+
+    def __init__(self, name: str, size: int, boot_layer: Optional[LayerOutput] = None,
+                 boot_bias=None, is_seq: bool = False):
+        enforce(_collector.group_stack, "memory() outside recurrent_group")
+        group = _collector.group_stack[-1]
+        self.link_name = f"{name}@pre@{group.name}"
+        group.memories.append({
+            "layer_name": name, "link_name": self.link_name, "size": size,
+            "boot_layer_name": boot_layer.name if boot_layer else None,
+        })
+        self.out = LayerOutput(name=self.link_name, layer_type="memory",
+                               size=size)
+
+    def __getattr__(self, item):
+        return getattr(self.out, item)
+
+
+def recurrent_group(step: Callable, input, name: Optional[str] = None,
+                    reverse: bool = False) -> Union[LayerOutput, List[LayerOutput]]:
+    """``recurrent_group``: run ``step`` once to trace the per-step net.
+
+    ``input``: StepInput(seq) entries are scanned; plain LayerOutputs are
+    read-only (static) inputs visible at every step.
+    """
+    name = name or _collector.unique_name("recurrent_group")
+    sub = SubModelConfig(name=name, reversed=reverse)
+    ins = _as_list(input)
+    step_args = []
+    for i in ins:
+        if isinstance(i, StepInput):
+            sub.in_links.append(i.layer.name)
+            # inside the group the step fn sees a per-frame view, same name
+            step_args.append(LayerOutput(name=i.layer.name, layer_type="frame",
+                                         size=i.layer.size))
+        else:
+            step_args.append(i)
+    _collector.group_stack.append(sub)
+    try:
+        outs = step(*step_args)
+    finally:
+        _collector.group_stack.pop()
+    out_list = _as_list(outs)
+    sub.out_links = [o.name for o in out_list]
+    _collector.sub_models.append(sub)
+    results = [LayerOutput(name=o.name, layer_type="group_output", size=o.size)
+               for o in out_list]
+    return results[0] if len(results) == 1 else results
+
+
+def simple_rnn_group(input, size, act=None, name=None, reverse=False):
+    def step(x):
+        mem = memory(name=f"{name or 'rnn'}_step", size=size)
+        return fc([x, mem.out], size=size, act=act or TanhActivation(),
+                  name=f"{name or 'rnn'}_step")
+
+    return recurrent_group(step, [StepInput(_as_list(input)[0])],
+                           name=name, reverse=reverse)
+
+
+# ------------------------------------------------------- sequence layers
+
+
+def pooling(input: Input, pooling_type: Optional[BasePoolingType] = None,
+            name: Optional[str] = None, agg_level=None,
+            stride: int = -1) -> LayerOutput:
+    inp = _as_list(input)[0]
+    ptype = (pooling_type or AvgPooling()).name
+    lt = {"max": "max", "average": "average", "sum": "average",
+          "sqrt": "average"}[ptype]
+    attrs = {"stride": stride}
+    if ptype in ("sum", "sqrt", "average"):
+        attrs["average_strategy"] = {"average": "average", "sum": "sum",
+                                     "sqrt": "squarerootn"}[ptype]
+    return _add_layer(name, lt, inp.size, _mk_inputs([inp]), None, False,
+                      attrs)
+
+
+pooling_layer = pooling
+
+
+def last_seq(input: Input, name: Optional[str] = None, agg_level=None,
+             stride: int = -1) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "seqlastins", inp.size, _mk_inputs([inp]),
+                      None, False, {"stride": stride})
+
+
+def first_seq(input: Input, name: Optional[str] = None,
+              agg_level=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "seqfirstins", inp.size, _mk_inputs([inp]))
+
+
+def expand(input: Input, expand_as: LayerOutput, name: Optional[str] = None,
+           expand_level=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "expand", inp.size,
+                      _mk_inputs([inp, expand_as]))
+
+
+expand_layer = expand
+
+
+def seq_concat(a: LayerOutput, b: LayerOutput,
+               name: Optional[str] = None) -> LayerOutput:
+    return _add_layer(name, "seqconcat", a.size, _mk_inputs([a, b]))
+
+
+seq_concat_layer = seq_concat
+
+
+def seq_reshape(input: Input, reshape_size: int,
+                name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "seqreshape", reshape_size, _mk_inputs([inp]))
+
+
+seq_reshape_layer = seq_reshape
+
+
+def seq_slice(input: Input, starts=None, ends=None,
+              name: Optional[str] = None) -> LayerOutput:
+    ins = [_as_list(input)[0]]
+    if starts is not None:
+        ins.append(starts)
+    if ends is not None:
+        ins.append(ends)
+    return _add_layer(name, "seq_slice", ins[0].size, _mk_inputs(ins))
+
+
+seq_slice_layer = seq_slice
+
+
+def sub_seq(input: Input, offsets: LayerOutput, sizes: LayerOutput,
+            name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "subseq", inp.size,
+                      _mk_inputs([inp, offsets, sizes]))
+
+
+def kmax_seq_score(input: Input, beam_size: int = 1,
+                   name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "kmax_seq_score", beam_size, _mk_inputs([inp]),
+                      None, False, {"beam_size": beam_size})
+
+
+kmax_sequence_score_layer = kmax_seq_score
+
+
+def max_id(input: Input, name: Optional[str] = None,
+           beam_size: int = 1) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "maxid", beam_size, _mk_inputs([inp]), None,
+                      False, {"beam_size": beam_size})
+
+
+maxid_layer = max_id
+
+
+def sampling_id(input: Input, name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "sampling_id", 1, _mk_inputs([inp]))
+
+
+sampling_id_layer = sampling_id
+
+
+def eos(input: Input, eos_id: int, name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "eos_id", 1, _mk_inputs([inp]), None, False,
+                      {"eos_id": eos_id})
+
+
+eos_layer = eos
+
+
+# ------------------------------------------------------------ glue layers
+
+
+def _simple(ltype: str):
+    def f(input: Input, name: Optional[str] = None, act=None,
+          **attrs) -> LayerOutput:
+        ins = _as_list(input)
+        return _add_layer(name, ltype, ins[0].size, _mk_inputs(ins), act,
+                          False, attrs or {})
+
+    f.__name__ = ltype
+    return f
+
+
+interpolation_layer = _simple("interpolation")
+power_layer = _simple("power")
+scaling_layer = _simple("scaling")
+trans_layer = _simple("trans")
+row_l2_norm_layer = _simple("row_l2_norm")
+sum_to_one_norm_layer = _simple("sum_to_one_norm")
+dot_prod_layer = _simple("dot_prod")
+out_prod_layer = _simple("out_prod")
+convex_comb_layer = _simple("convex_comb")
+
+
+def slope_intercept(input: Input, slope: float = 1.0, intercept: float = 0.0,
+                    name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "slope_intercept", inp.size, _mk_inputs([inp]),
+                      None, False, {"slope": slope, "intercept": intercept})
+
+
+slope_intercept_layer = slope_intercept
+
+
+def clip(input: Input, min: float, max: float,
+         name: Optional[str] = None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "clip", inp.size, _mk_inputs([inp]), None, False,
+                      {"min": min, "max": max})
+
+
+clip_layer = clip
+
+
+def scale_shift(input: Input, name: Optional[str] = None,
+                bias_attr=True) -> LayerOutput:
+    inp = _as_list(input)[0]
+    return _add_layer(name, "scale_shift", inp.size, _mk_inputs([inp]),
+                      None, bias_attr)
+
+
+scale_shift_layer = scale_shift
+
+
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0,
+            size: int = 1, name: Optional[str] = None) -> LayerOutput:
+    lt = "cos" if size == 1 else "cos_vm"
+    return _add_layer(name, lt, size, _mk_inputs([a, b]), None, False,
+                      {"cos_scale": scale})
+
+
+def prelu(input: Input, partial_sum: int = 1,
+          name: Optional[str] = None, param_attr=None) -> LayerOutput:
+    inp = _as_list(input)[0]
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "prelu", inp.size, _mk_inputs([inp], pas),
+                      None, False, {"partial_sum": partial_sum},
+                      param_attrs=pas)
+
+
+prelu_layer = prelu
+
+
+def multiplex(index: LayerOutput, inputs: Sequence[LayerOutput],
+              name: Optional[str] = None) -> LayerOutput:
+    ins = [index] + list(inputs)
+    return _add_layer(name, "multiplex", inputs[0].size, _mk_inputs(ins))
+
+
+multiplex_layer = multiplex
+
+
+# ------------------------------------------------------------ cost layers
+
+
+def classification_cost(input: LayerOutput, label: LayerOutput,
+                        weight: Optional[LayerOutput] = None,
+                        name: Optional[str] = None,
+                        coeff: float = 1.0) -> LayerOutput:
+    ins = [input, label] + ([weight] if weight else [])
+    return _add_layer(name, "multi-class-cross-entropy", 1, _mk_inputs(ins),
+                      None, False, {"coeff": coeff})
+
+
+def cross_entropy_cost(input, label, name=None, coeff=1.0,
+                       weight=None) -> LayerOutput:
+    return classification_cost(input, label, weight, name, coeff)
+
+
+cross_entropy = cross_entropy_cost
+
+
+def cross_entropy_with_selfnorm_cost(input, label, name=None, coeff=1.0,
+                                     softmax_selfnorm_alpha=0.1) -> LayerOutput:
+    return _add_layer(name, "multi_class_cross_entropy_with_selfnorm", 1,
+                      _mk_inputs([input, label]), None, False,
+                      {"coeff": coeff,
+                       "softmax_selfnorm_alpha": softmax_selfnorm_alpha})
+
+
+def square_error_cost(input, label, name=None, coeff=1.0,
+                      weight=None) -> LayerOutput:
+    ins = [input, label] + ([weight] if weight else [])
+    return _add_layer(name, "square_error", 1, _mk_inputs(ins), None, False,
+                      {"coeff": coeff})
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None,
+                                          coeff=1.0) -> LayerOutput:
+    return _add_layer(name, "multi_binary_label_cross_entropy", 1,
+                      _mk_inputs([input, label]), None, False, {"coeff": coeff})
+
+
+def soft_binary_class_cross_entropy_cost(input, label, name=None,
+                                         coeff=1.0) -> LayerOutput:
+    return _add_layer(name, "soft_binary_class_cross_entropy", 1,
+                      _mk_inputs([input, label]), None, False, {"coeff": coeff})
+
+
+def rank_cost(left, right, label, weight=None, name=None,
+              coeff=1.0) -> LayerOutput:
+    ins = [left, right, label] + ([weight] if weight else [])
+    return _add_layer(name, "rank-cost", 1, _mk_inputs(ins), None, False,
+                      {"coeff": coeff})
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5,
+                max_sort_size=-1) -> LayerOutput:
+    return _add_layer(name, "lambda_cost", 1, _mk_inputs([input, score]),
+                      None, False, {"NDCG_num": NDCG_num})
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0,
+                          coeff=1.0) -> LayerOutput:
+    return _add_layer(name, "huber_regression", 1, _mk_inputs([input, label]),
+                      None, False, {"delta": delta, "coeff": coeff})
+
+
+def huber_classification_cost(input, label, name=None,
+                              coeff=1.0) -> LayerOutput:
+    return _add_layer(name, "huber_classification", 1,
+                      _mk_inputs([input, label]), None, False,
+                      {"coeff": coeff})
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0) -> LayerOutput:
+    return _add_layer(name, "smooth_l1", 1, _mk_inputs([input, label]),
+                      None, False, {"coeff": coeff})
+
+
+def sum_cost(input, name=None) -> LayerOutput:
+    return _add_layer(name, "sum_cost", 1, _mk_inputs([_as_list(input)[0]]))
+
+
+def crf(input: LayerOutput, label: LayerOutput, size: Optional[int] = None,
+        weight=None, param_attr=None, name=None) -> LayerOutput:
+    n = size or input.size
+    ins = [input, label] + ([weight] if weight else [])
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "crf", n, _mk_inputs(ins, pas), None, False,
+                      param_attrs=pas)
+
+
+crf_layer = crf
+
+
+def crf_decoding(input: LayerOutput, size: Optional[int] = None,
+                 label: Optional[LayerOutput] = None, param_attr=None,
+                 name=None) -> LayerOutput:
+    n = size or input.size
+    ins = [input] + ([label] if label else [])
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "crf_decoding", n, _mk_inputs(ins, pas), None,
+                      False, param_attrs=pas)
+
+
+crf_decoding_layer = crf_decoding
+
+
+def ctc(input: LayerOutput, label: LayerOutput, size: Optional[int] = None,
+        norm_by_times: bool = False, name=None) -> LayerOutput:
+    return _add_layer(name, "ctc", size or input.size,
+                      _mk_inputs([input, label]), None, False,
+                      {"norm_by_times": norm_by_times})
+
+
+ctc_layer = ctc
+
+
+def warp_ctc(input: LayerOutput, label: LayerOutput, size=None, blank=0,
+             norm_by_times=False, name=None) -> LayerOutput:
+    return _add_layer(name, "warp_ctc", size or input.size,
+                      _mk_inputs([input, label]), None, False,
+                      {"blank": blank, "norm_by_times": norm_by_times})
+
+
+warp_ctc_layer = warp_ctc
+
+
+def nce(input: LayerOutput, label: LayerOutput, num_classes: int,
+        num_neg_samples: int = 10, name=None, param_attr=None,
+        bias_attr=True) -> LayerOutput:
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "nce", 1, _mk_inputs([input, label], pas), None,
+                      bias_attr, {"num_classes": num_classes,
+                                  "num_neg_samples": num_neg_samples},
+                      param_attrs=pas)
+
+
+nce_layer = nce
+
+
+def hsigmoid(input: LayerOutput, label: LayerOutput, num_classes: int,
+             name=None, param_attr=None, bias_attr=True) -> LayerOutput:
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "hsigmoid", 1, _mk_inputs([input, label], pas),
+                      None, bias_attr, {"num_classes": num_classes},
+                      param_attrs=pas)
+
+
+hsigmoid_layer = hsigmoid
+
+
+# --------------------------------------------------------------- topology
+
+
+def topology(outputs: Input,
+             extra_layers: Optional[Input] = None) -> ModelConfig:
+    """Extract the reachable subgraph as a ModelConfig
+    (``Topology``/``parse_network`` equivalent)."""
+    outs = _as_list(outputs) + _as_list(extra_layers)
+    by_name = _collector.by_name
+    mem_links = {}
+    for sm in _collector.sub_models:
+        for m in sm.memories:
+            mem_links.setdefault(m.get("link_name"), m["layer_name"])
+    group_by_layer = {}
+    for sm in _collector.sub_models:
+        for ln in sm.layer_names:
+            group_by_layer[ln] = sm
+
+    needed: List[str] = []
+    seen = set()
+
+    def visit(name: str):
+        if name in seen:
+            return
+        seen.add(name)
+        if name in mem_links:
+            visit(mem_links[name])
+            return
+        conf = by_name.get(name)
+        if conf is None:
+            return
+        # pull the whole group when any member is needed
+        sm = group_by_layer.get(name)
+        if sm is not None:
+            for l in sm.in_links:
+                visit(l)
+            for m in sm.memories:
+                if m.get("boot_layer_name"):
+                    visit(m["boot_layer_name"])
+            for ln in sm.layer_names:
+                if ln not in seen:
+                    seen.add(ln)
+                    for i in by_name[ln].inputs:
+                        visit(i.input_layer_name)
+                    needed.append(ln)
+        for i in conf.inputs:
+            visit(i.input_layer_name)
+        needed.append(name)
+
+    for o in outs:
+        visit(o.name)
+
+    layers = [by_name[n] for n in needed if n in by_name]
+    order = {l.name: i for i, l in enumerate(layers)}
+    layers.sort(key=lambda l: order[l.name])
+    used_groups = [sm for sm in _collector.sub_models
+                   if any(ln in seen for ln in sm.layer_names)]
+    return ModelConfig(
+        layers=layers,
+        parameters=list(_collector.parameters),
+        input_layer_names=[l.name for l in layers if l.type == "data"],
+        output_layer_names=[o.name for o in _as_list(outputs)],
+        sub_models=([SubModelConfig(name="root")] + used_groups)
+        if used_groups else [],
+    )
+
+
+@contextlib.contextmanager
+def config_scope():
+    """Isolated collector scope (parse one config independently)."""
+    global _collector
+    old = _collector
+    _collector = ConfigCollector()
+    try:
+        yield _collector
+    finally:
+        _collector = old

@@ -1,0 +1,34 @@
+from . import datasets, reader
+from .feeder import (
+    DataFeeder,
+    InputType,
+    dense_vector,
+    dense_vector_sequence,
+    dense_vector_sub_sequence,
+    integer_value,
+    integer_value_sequence,
+    integer_value_sub_sequence,
+    sparse_binary_vector,
+    sparse_binary_vector_sequence,
+    sparse_float_vector,
+    sparse_float_vector_sequence,
+)
+from .provider import provider
+
+__all__ = [
+    "DataFeeder",
+    "InputType",
+    "datasets",
+    "dense_vector",
+    "dense_vector_sequence",
+    "dense_vector_sub_sequence",
+    "integer_value",
+    "integer_value_sequence",
+    "integer_value_sub_sequence",
+    "provider",
+    "reader",
+    "sparse_binary_vector",
+    "sparse_binary_vector_sequence",
+    "sparse_float_vector",
+    "sparse_float_vector_sequence",
+]

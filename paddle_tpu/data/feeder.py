@@ -1,0 +1,122 @@
+"""Input-type declarations and the batch feeder.
+
+Port of the reference's data-type vocabulary
+(``python/paddle/trainer/PyDataProvider2.py``: dense_vector,
+sparse_binary_vector, sparse_float_vector, integer_value, plus ``_sequence``
+/ ``_sub_sequence`` variants) and the v2 ``DataFeeder``
+(``python/paddle/v2/data_feeder.py`` + ``py_paddle/dataprovider_converter.py``)
+that turns a minibatch of Python tuples into device arrays.
+
+TPU specifics: sequences become padded :class:`SequenceBatch` (bucketed
+lengths bound recompilation); sparse vectors densify by default (XLA) or
+stay as (ids, values) pairs for the sharded-embedding path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sequence import SequenceBatch, pad_batch, pad_nested_batch
+from ..utils import ConfigError, enforce
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    dim: int
+    seq_level: int = 0  # 0: none, 1: sequence, 2: sub-sequence
+    kind: str = "dense"  # dense | sparse_binary | sparse_float | index
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType(dim, 0, "dense")
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, 1, "dense")
+
+
+def sparse_binary_vector(dim: int) -> InputType:
+    return InputType(dim, 0, "sparse_binary")
+
+
+def sparse_binary_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, 1, "sparse_binary")
+
+
+def sparse_float_vector(dim: int) -> InputType:
+    return InputType(dim, 0, "sparse_float")
+
+
+def sparse_float_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, 1, "sparse_float")
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType(value_range, 0, "index")
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType(value_range, 1, "index")
+
+
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    return InputType(value_range, 2, "index")
+
+
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, 2, "dense")
+
+
+class DataFeeder:
+    """feeding: list of (data_layer_name, InputType) in sample tuple order."""
+
+    def __init__(self, feeding: Sequence, buckets: Optional[Sequence[int]] = None):
+        self.feeding = [(name, t) for name, t in feeding]
+        self.buckets = buckets
+
+    def _densify(self, row, dim: int, kind: str) -> np.ndarray:
+        if kind == "sparse_binary":
+            out = np.zeros(dim, np.float32)
+            out[np.asarray(row, np.int64)] = 1.0
+            return out
+        if kind == "sparse_float":
+            ids, vals = zip(*row) if row else ((), ())
+            out = np.zeros(dim, np.float32)
+            out[np.asarray(ids, np.int64)] = np.asarray(vals, np.float32)
+            return out
+        return np.asarray(row, np.float32)
+
+    def convert(self, batch: List[Sequence]) -> Dict[str, Any]:
+        """minibatch (list of sample tuples) → feed dict."""
+        feed: Dict[str, Any] = {}
+        for slot, (name, itype) in enumerate(self.feeding):
+            col = [sample[slot] for sample in batch]
+            if itype.seq_level == 0:
+                if itype.kind == "index":
+                    feed[name] = jnp.asarray(np.asarray(col, np.int32))
+                else:
+                    rows = [self._densify(r, itype.dim, itype.kind) for r in col]
+                    feed[name] = jnp.asarray(np.stack(rows))
+            elif itype.seq_level == 1:
+                if itype.kind == "index":
+                    seqs = [np.asarray(r, np.int32) for r in col]
+                    feed[name] = pad_batch(seqs, buckets=self.buckets,
+                                           dtype=np.int32)
+                else:
+                    seqs = [np.stack([self._densify(x, itype.dim, itype.kind)
+                                      for x in r]) if len(r) else
+                            np.zeros((0, itype.dim), np.float32) for r in col]
+                    feed[name] = pad_batch(seqs, buckets=self.buckets)
+            else:  # sub-sequence
+                if itype.kind == "index":
+                    nested = [[np.asarray(s, np.int32) for s in r] for r in col]
+                    feed[name] = pad_nested_batch(nested, dtype=np.int32)
+                else:
+                    nested = [[np.stack([self._densify(x, itype.dim, itype.kind)
+                                         for x in s]) for s in r] for r in col]
+                    feed[name] = pad_nested_batch(nested)
+        return feed

@@ -1,0 +1,77 @@
+"""PyDataProvider2-compatible ``@provider`` decorator.
+
+Reference: ``python/paddle/trainer/PyDataProvider2.py:365`` — users declare a
+generator of samples with input types; the C++ engine
+(``PyDataProvider2.cpp:195``) pulls from it with pooling/shuffling.  Here the
+decorator just wraps the generator into the reader protocol plus metadata;
+the trainer's feeder consumes it directly (no embedded-interpreter hop).
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+from typing import Any, Callable, Optional, Sequence
+
+from .feeder import InputType
+
+
+class ProviderWrapper:
+    def __init__(self, generator: Callable, input_types, cache: bool,
+                 should_shuffle: bool, pool_size: int,
+                 init_hook: Optional[Callable]):
+        self.generator = generator
+        self.input_types = input_types
+        self.cache = cache
+        self.should_shuffle = should_shuffle
+        self.pool_size = pool_size
+        self.init_hook = init_hook
+        self._cached = None
+        self.settings = type("Settings", (), {})()
+        self.settings.input_types = input_types
+
+    def reader(self, *file_list, **kwargs):
+        """Build a reader over the provider's generator."""
+        if self.init_hook:
+            self.init_hook(self.settings, file_list=file_list, **kwargs)
+
+        def read():
+            if self.cache and self._cached is not None:
+                data = self._cached
+            else:
+                data = []
+                files = file_list or [None]
+                for fname in files:
+                    for sample in self.generator(self.settings, fname):
+                        if self.cache:
+                            data.append(sample)
+                        else:
+                            yield sample
+                if self.cache:
+                    self._cached = data
+                else:
+                    return
+            if self.should_shuffle:
+                data = list(data)
+                random.shuffle(data)
+            yield from data
+
+        if self.should_shuffle and not self.cache:
+            from .reader import shuffle
+
+            return shuffle(read, max(self.pool_size, 1) or 1000)
+        return read
+
+
+def provider(input_types=None, cache=False, should_shuffle=True,
+             pool_size=1000, min_pool_size=-1, calc_batch_size=None,
+             init_hook=None, **_ignored):
+    """``@provider(input_types=[...])`` decorator (PyDataProvider2 API)."""
+
+    def deco(fn):
+        wrapper = ProviderWrapper(fn, input_types, cache, should_shuffle,
+                                  pool_size, init_hook)
+        functools.update_wrapper(wrapper, fn, updated=[])
+        return wrapper
+
+    return deco

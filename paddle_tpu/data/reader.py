@@ -1,0 +1,218 @@
+"""Reader creators and combinators.
+
+Port of the v2 functional reader stack
+(``python/paddle/v2/reader/decorator.py``: map_readers, shuffle, chain,
+compose, buffered, firstn, xmap_readers; ``creator.py``: np_array,
+text_file).  A *reader* is a zero-arg callable returning an iterable of
+samples — identical contract to the reference, so user reader code ports
+unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Any, Callable, Iterable, List, Sequence
+
+Reader = Callable[[], Iterable[Any]]
+
+
+def np_array(x) -> Reader:
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path: str) -> Reader:
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader: Reader, buf_size: int, seed: int = None) -> Reader:
+    def shuffled():
+        rng = random.Random(seed)
+        buf: List[Any] = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers: Reader) -> Reader:
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip readers into tuple samples (flattening tuple components)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        if check_alignment:
+            for items in zip(*[r() for r in readers]):
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*[r() for r in readers]):
+                yield sum((make_tuple(i) for i in items if i is not None), ())
+
+    return reader
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """Double-buffering via a background thread — the TPU-host overlap
+    equivalent of ``DataProvider.h:360``'s double-buffer queue."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    def reader_n():
+        return itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader: Reader) -> Reader:
+    """Materialize once, then replay from memory (pass-in-memory cache,
+    ``PyDataProvider2.cpp:70``)."""
+    data: List[Any] = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            for e in reader():
+                data.append(e)
+                yield e
+            filled[0] = True
+        else:
+            yield from data
+
+    return cached
+
+
+def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
+                 buffer_size: int, order: bool = False) -> Reader:
+    """Parallel map over a reader with worker threads (reference uses
+    threads too — CPython-level parallelism for IO/numpy work)."""
+
+    class _End:
+        pass
+
+    def xreader():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+
+        def feed():
+            for i, e in enumerate(reader()):
+                in_q.put((i, e))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, e = item
+                out_q.put((i, mapper(e)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        if order:
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, e = item
+                pending[i] = e
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return xreader
+
+
+def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
+    """Group samples into lists (``paddle.v2.minibatch.batch``).
+
+    drop_last defaults True on TPU: fixed batch shapes avoid recompiles.
+    """
+
+    def batch_reader():
+        b = []
+        for e in reader():
+            b.append(e)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,29 @@
+from .evaluators import (
+    EVALUATORS,
+    AucEvaluator,
+    ChunkEvaluator,
+    ClassificationErrorEvaluator,
+    ColumnSumEvaluator,
+    CTCErrorEvaluator,
+    Evaluator,
+    PnpairEvaluator,
+    PrecisionRecallEvaluator,
+    RankAucEvaluator,
+    SumEvaluator,
+    create_evaluator,
+)
+
+__all__ = [
+    "EVALUATORS",
+    "AucEvaluator",
+    "ChunkEvaluator",
+    "ClassificationErrorEvaluator",
+    "ColumnSumEvaluator",
+    "CTCErrorEvaluator",
+    "Evaluator",
+    "PnpairEvaluator",
+    "PrecisionRecallEvaluator",
+    "RankAucEvaluator",
+    "SumEvaluator",
+    "create_evaluator",
+]

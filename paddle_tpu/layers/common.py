@@ -260,11 +260,16 @@ class PowerLayer(Layer):
 
 @register_layer("scaling")
 class ScalingLayer(Layer):
-    """Row-wise scale: weight (first input, [B,1]) * x (second input)."""
+    """Row-wise scale: weight (first input, one scalar per row/step) * x
+    (second input).  Works per-timestep on sequences."""
 
     def forward(self, params, inputs, ctx):
-        w = value_of(inputs[0]).reshape(-1, 1)
+        w = value_of(inputs[0])
         x = value_of(inputs[1])
+        if w.ndim == x.ndim:
+            pass  # [B(,T),1] broadcasts
+        else:
+            w = w.reshape(w.shape + (1,) * (x.ndim - w.ndim))
         return self.finalize(like(inputs[1], w * x), ctx)
 
 

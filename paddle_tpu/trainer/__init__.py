@@ -1,0 +1,20 @@
+from . import events
+from .checkpoint import (
+    latest_checkpoint,
+    load_buffers,
+    load_opt_state,
+    load_params,
+    save_checkpoint,
+)
+from .trainer import Trainer, optimizer_from_config
+
+__all__ = [
+    "Trainer",
+    "events",
+    "latest_checkpoint",
+    "load_buffers",
+    "load_opt_state",
+    "load_params",
+    "optimizer_from_config",
+    "save_checkpoint",
+]

@@ -1,0 +1,27 @@
+"""``paddle_tpu.v2`` — the v2-API-compatible namespace.
+
+Mirrors ``python/paddle/v2``'s module layout so reference user code ports
+with an import swap: ``layer``, ``activation``, ``pooling``, ``attr``,
+``data_type``, ``optimizer``, ``trainer``, ``event``, ``dataset``,
+``reader``, ``networks``, ``evaluator``, ``inference``, ``parameters``.
+"""
+
+from . import activation, attr, data_type, dataset, evaluator, event
+from . import inference, layer, networks, optimizer, pooling, reader, trainer
+from .inference import infer
+from .parameters import Parameters
+
+__all__ = [
+    "activation", "attr", "data_type", "dataset", "evaluator", "event",
+    "inference", "infer", "layer", "networks", "optimizer", "pooling",
+    "reader", "trainer", "Parameters", "init",
+]
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1, **kwargs) -> None:
+    """v2 ``paddle.init`` compatibility shim (device setup is automatic on
+    TPU; trainer_count maps to the data-mesh axis)."""
+    from ..utils import FLAGS
+
+    if trainer_count:
+        FLAGS.set("trainer_count", trainer_count)

@@ -1,0 +1,6 @@
+from ..config.dsl import ExtraAttr, ParamAttr  # noqa: F401
+
+Param = ParamAttr
+Extra = ExtraAttr
+ParameterAttribute = ParamAttr
+ExtraLayerAttribute = ExtraAttr

@@ -1,0 +1,43 @@
+"""paddle.v2.dataset equivalent (synthetic-fallback corpora)."""
+
+from ..data import datasets as _d
+
+
+class mnist:
+    train = staticmethod(_d.mnist_train)
+    test = staticmethod(_d.mnist_test)
+
+
+class cifar:
+    train10 = staticmethod(_d.cifar10_train)
+    test10 = staticmethod(_d.cifar10_test)
+
+
+class imdb:
+    word_dict = staticmethod(_d.imdb_word_dict)
+    train = staticmethod(_d.imdb_train)
+    test = staticmethod(_d.imdb_test)
+
+
+class imikolov:
+    train = staticmethod(_d.imikolov_train)
+
+
+class uci_housing:
+    train = staticmethod(_d.uci_housing_train)
+    test = staticmethod(_d.uci_housing_test)
+
+
+class wmt14:
+    train = staticmethod(_d.wmt14_train)
+    test = staticmethod(_d.wmt14_test)
+    dicts = staticmethod(_d.wmt14_dicts)
+
+
+class conll05:
+    test = staticmethod(_d.conll05_train)
+    train = staticmethod(_d.conll05_train)
+
+
+class criteo:
+    train = staticmethod(_d.criteo_ctr_train)

@@ -1,0 +1,7 @@
+from ..trainer.events import (  # noqa: F401
+    BeginIteration,
+    BeginPass,
+    EndIteration,
+    EndPass,
+    TestResult,
+)

@@ -1,0 +1,49 @@
+"""paddle.v2.inference equivalent (``Inference:10``, ``infer():111``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config.dsl import topology
+from ..core.sequence import SequenceBatch, value_of
+from ..layers.network import NeuralNetwork
+
+
+class Inference:
+    def __init__(self, output_layer, parameters=None):
+        self.model_config = topology(output_layer)
+        self.network = NeuralNetwork(self.model_config)
+        self.params = self.network.init_params()
+        self.buffers = self.network.init_buffers()
+        if parameters is not None:
+            import jax.numpy as jnp
+
+            for name in parameters.names():
+                if name in self.params:
+                    self.params[name] = jnp.asarray(parameters.get(name))
+
+    def iter_infer(self, input, feeding=None):
+        from .trainer import SGD
+
+        feeder = SGD._feeder(self, feeding) if feeding else None
+        for batch in input:
+            feed = feeder.convert(batch) if feeder else batch
+            values, _ = self.network.forward(
+                self.params, feed, self.buffers, is_training=False)
+            outs = self.network.outputs(values)
+            yield [np.asarray(value_of(v)) for v in outs.values()]
+
+    def infer(self, input, feeding=None):
+        results = []
+        for out in self.iter_infer(input, feeding):
+            results.append(out[0] if len(out) == 1 else out)
+        if len(results) == 1:
+            return results[0]
+        return np.concatenate(results) if results and \
+            results[0].ndim > 0 else results
+
+
+def infer(output_layer, parameters=None, input=None, feeding=None):
+    return Inference(output_layer, parameters).infer(input, feeding)

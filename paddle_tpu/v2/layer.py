@@ -1,0 +1,14 @@
+"""paddle.v2.layer equivalent — re-export of the DSL."""
+
+from ..config.dsl import *  # noqa: F401,F403
+from ..config.dsl import (  # noqa: F401
+    LayerOutput,
+    StepInput,
+    memory,
+    mixed,
+    recurrent_group,
+    topology,
+)
+
+# parse_network equivalent
+parse_network = topology

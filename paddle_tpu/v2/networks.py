@@ -1,0 +1,174 @@
+"""Composite network helpers.
+
+Reference: ``trainer_config_helpers/networks.py`` — simple_img_conv_pool,
+img_conv_group, vgg_16_network, simple_lstm, lstmemory_group, simple_gru,
+bidirectional_lstm, stacked LSTM pieces, sequence_conv_pool,
+simple_attention.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..config import dsl
+from ..config.dsl import (
+    AvgPooling,
+    LinearActivation,
+    MaxPooling,
+    ReluActivation,
+    SequenceSoftmaxActivation,
+    SigmoidActivation,
+    SoftmaxActivation,
+    StepInput,
+    TanhActivation,
+    batch_norm,
+    concat,
+    data,
+    dropout,
+    expand,
+    fc,
+    first_seq,
+    full_matrix_projection,
+    grumemory,
+    img_conv,
+    img_pool,
+    last_seq,
+    lstmemory,
+    memory,
+    mixed,
+    pooling,
+    recurrent_group,
+)
+
+
+def simple_img_conv_pool(input, filter_size: int, num_filters: int,
+                         pool_size: int, num_channel: Optional[int] = None,
+                         pool_stride: int = 2, act=None, padding: int = 1,
+                         img_size: Optional[int] = None, name=None):
+    conv = img_conv(input, filter_size=filter_size, num_filters=num_filters,
+                    num_channels=num_channel, padding=padding,
+                    img_size=img_size, act=act or ReluActivation(),
+                    name=name and f"{name}_conv")
+    return img_pool(conv, pool_size=pool_size, stride=pool_stride,
+                    pool_type=MaxPooling(), name=name and f"{name}_pool")
+
+
+def img_conv_group(input, conv_num_filter: Sequence[int],
+                   conv_filter_size: int = 3, num_channels=None,
+                   pool_size: int = 2, pool_stride: int = 2,
+                   conv_act=None, conv_with_batchnorm: bool = False,
+                   conv_batchnorm_drop_rate=None, pool_type=None,
+                   img_size: Optional[int] = None):
+    tmp = input
+    channels = num_channels
+    for i, nf in enumerate(conv_num_filter):
+        tmp = img_conv(tmp, filter_size=conv_filter_size, num_filters=nf,
+                       num_channels=channels, padding=1, img_size=img_size,
+                       act=LinearActivation() if conv_with_batchnorm
+                       else (conv_act or ReluActivation()))
+        img_size = None
+        channels = None
+        if conv_with_batchnorm:
+            drop = 0.0
+            if conv_batchnorm_drop_rate:
+                drop = conv_batchnorm_drop_rate[i] \
+                    if isinstance(conv_batchnorm_drop_rate, (list, tuple)) \
+                    else conv_batchnorm_drop_rate
+            tmp = batch_norm(tmp, act=conv_act or ReluActivation(),
+                             layer_attr=dsl.ExtraAttr(drop_rate=drop))
+    return img_pool(tmp, pool_size=pool_size, stride=pool_stride,
+                    pool_type=pool_type or MaxPooling())
+
+
+def vgg_16_network(input_image, num_channels: int, num_classes: int = 1000,
+                   img_size: int = 224):
+    """``vgg_16_network`` (networks.py): 5 conv groups + 2×fc4096."""
+    tmp = img_conv_group(input_image, [64, 64], num_channels=num_channels,
+                         conv_with_batchnorm=True, img_size=img_size)
+    tmp = img_conv_group(tmp, [128, 128], conv_with_batchnorm=True)
+    tmp = img_conv_group(tmp, [256, 256, 256], conv_with_batchnorm=True)
+    tmp = img_conv_group(tmp, [512, 512, 512], conv_with_batchnorm=True)
+    tmp = img_conv_group(tmp, [512, 512, 512], conv_with_batchnorm=True)
+    tmp = fc(tmp, size=4096, act=ReluActivation(),
+             layer_attr=dsl.ExtraAttr(drop_rate=0.5))
+    tmp = fc(tmp, size=4096, act=ReluActivation(),
+             layer_attr=dsl.ExtraAttr(drop_rate=0.5))
+    return fc(tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def simple_lstm(input, size: int, name=None, reverse: bool = False,
+                mat_param_attr=None, bias_param_attr=None,
+                inner_param_attr=None, act=None, gate_act=None,
+                state_act=None):
+    """fc(4H) + lstmemory (``simple_lstm`` in networks.py)."""
+    proj = fc(input, size=size * 4, act=LinearActivation(), bias_attr=False,
+              param_attr=mat_param_attr, name=name and f"{name}_transform")
+    return lstmemory(proj, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, state_act=state_act,
+                     bias_attr=bias_param_attr if bias_param_attr is not None
+                     else True,
+                     param_attr=inner_param_attr)
+
+
+def simple_gru(input, size: int, name=None, reverse: bool = False, act=None,
+               gate_act=None, **kw):
+    proj = fc(input, size=size * 3, act=LinearActivation(), bias_attr=False,
+              name=name and f"{name}_transform")
+    return grumemory(proj, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act)
+
+
+def bidirectional_lstm(input, size: int, name=None, return_seq: bool = False):
+    fwd = simple_lstm(input, size, name=name and f"{name}_fwd")
+    bwd = simple_lstm(input, size, name=name and f"{name}_bwd", reverse=True)
+    if return_seq:
+        return concat([fwd, bwd])
+    return concat([last_seq(fwd), first_seq(bwd)])
+
+
+def stacked_lstm_net(input, hid_dim: int, stacked_num: int = 3,
+                     act=None):
+    """Stacked alternating-direction LSTM (sentiment demo topology)."""
+    lstm = simple_lstm(input, hid_dim)
+    inputs = [input, lstm]
+    for i in range(2, stacked_num + 1):
+        nxt = fc(inputs, size=hid_dim * 4, act=LinearActivation(),
+                 bias_attr=False)
+        lstm = lstmemory(nxt, reverse=(i % 2 == 0))
+        inputs = [nxt, lstm]
+    return lstm
+
+
+def sequence_conv_pool(input, context_len: int, hidden_size: int,
+                       name=None, context_start=None, pool_type=None,
+                       context_proj_param_attr=None, fc_act=None):
+    """context projection + fc + seq pooling (text conv)."""
+    ctx = mixed(
+        [dsl.context_projection(input, context_len, context_start)],
+        size=input.size * context_len, name=name and f"{name}_ctx")
+    h = fc(ctx, size=hidden_size, act=fc_act or LinearActivation(),
+           name=name and f"{name}_fc")
+    return pooling(h, pooling_type=pool_type or MaxPooling(),
+                   name=name and f"{name}_pool")
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None):
+    """Bahdanau attention (``simple_attention`` in networks.py):
+    score = v·tanh(enc_proj + dec_proj); context = Σ softmax(score)·enc."""
+    name = name or dsl._collector.unique_name("attention")
+    decoder_proj = fc(decoder_state, size=encoded_proj.size,
+                      act=LinearActivation(), bias_attr=False,
+                      param_attr=transform_param_attr,
+                      name=f"{name}_transform")
+    expanded = expand(decoder_proj, encoded_proj)
+    combined = dsl.addto([encoded_proj, expanded], act=TanhActivation(),
+                         name=f"{name}_combine")
+    attention_weight = fc(combined, size=1, act=SequenceSoftmaxActivation(),
+                          bias_attr=False, param_attr=softmax_param_attr,
+                          name=f"{name}_weight")
+    scaled = dsl.scaling_layer([attention_weight, encoded_sequence],
+                               name=f"{name}_scale")
+    return pooling(scaled, pooling_type=dsl.SumPooling(),
+                   name=f"{name}_context")

@@ -1,0 +1,57 @@
+"""paddle.v2.parameters equivalent: numpy view + tar-style checkpointing.
+
+Reference: ``python/paddle/v2/parameters.py`` (``to_tar:328``/``from_tar:358``
+— here the container format is the framework's npz checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Parameters:
+    def __init__(self):
+        self._trainer = None
+        self._store: Dict[str, np.ndarray] = {}
+
+    def attach(self, core_trainer) -> None:
+        self._trainer = core_trainer
+
+    def names(self):
+        if self._trainer is not None:
+            return sorted(self._trainer.params)
+        return sorted(self._store)
+
+    def get(self, name: str) -> np.ndarray:
+        if self._trainer is not None:
+            return np.asarray(self._trainer.params[name])
+        return self._store[name]
+
+    __getitem__ = get
+
+    def set(self, name: str, value) -> None:
+        if self._trainer is not None:
+            self._trainer.params[name] = jnp.asarray(value)
+        else:
+            self._store[name] = np.asarray(value)
+
+    __setitem__ = set
+
+    def to_tar(self, f) -> None:
+        """Serialize to an npz stream (keeps the to_tar name for parity)."""
+        data = {n: self.get(n) for n in self.names()}
+        np.savez(f, **data)
+
+    @staticmethod
+    def from_tar(f) -> "Parameters":
+        p = Parameters()
+        with np.load(f) as z:
+            for k in z.files:
+                p._store[k] = z[k]
+        return p
+
+    def append_gradient_machine(self, *_args) -> None:  # legacy no-op
+        pass

@@ -1,0 +1,6 @@
+from ..config.dsl import (  # noqa: F401
+    AvgPooling as Avg,
+    MaxPooling as Max,
+    SqrtPooling as Sqrt,
+    SumPooling as Sum,
+)

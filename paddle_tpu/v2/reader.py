@@ -1,0 +1,16 @@
+from ..data.reader import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    np_array,
+    shuffle,
+    text_file,
+    xmap_readers,
+)
+
+creator = type("creator", (), {"np_array": staticmethod(np_array),
+                               "text_file": staticmethod(text_file)})

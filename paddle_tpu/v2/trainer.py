@@ -1,0 +1,62 @@
+"""paddle.v2.trainer equivalent: the SGD event-loop trainer.
+
+Reference: ``python/paddle/v2/trainer.py:24`` — ``SGD(cost, parameters,
+update_equation).train(reader, num_passes, event_handler, feeding)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..config.dsl import LayerOutput, topology
+from ..data.feeder import DataFeeder
+from ..layers.network import NeuralNetwork
+from ..trainer.trainer import Trainer as _CoreTrainer
+from . import event as _event  # noqa: F401
+from .optimizer import Optimizer
+
+
+class SGD:
+    def __init__(self, cost, parameters=None, update_equation: Optimizer = None,
+                 extra_layers=None, is_local: bool = True):
+        self.model_config = topology(cost, extra_layers)
+        self.network = NeuralNetwork(self.model_config)
+        opt_conf = update_equation.conf if update_equation else None
+        self.core = _CoreTrainer(self.network, opt_config=opt_conf)
+        if parameters is not None:
+            parameters.attach(self.core)
+
+    def _feeder(self, feeding) -> Optional[DataFeeder]:
+        if feeding is None:
+            return None
+        lmap = {l.name: l for l in self.model_config.layers}
+        order = sorted(feeding, key=lambda n: feeding[n]) \
+            if isinstance(feeding, dict) else list(feeding)
+        from ..data.feeder import InputType
+
+        pairs = []
+        for name in order:
+            conf = lmap[name]
+            pairs.append((name, InputType(
+                dim=conf.size,
+                seq_level=conf.attrs.get("seq_level", 0),
+                kind=conf.attrs.get("kind", "dense"))))
+        return DataFeeder(pairs)
+
+    def train(self, reader, num_passes: int = 1, event_handler=None,
+              feeding=None, test_reader=None, evaluators: Sequence = ()):
+        self.core.train(reader, num_passes=num_passes,
+                        event_handler=event_handler,
+                        feeder=self._feeder(feeding),
+                        test_reader=test_reader, evaluators=evaluators)
+
+    def test(self, reader, feeding=None, evaluators: Sequence = ()):
+        return self.core.test(reader, self._feeder(feeding), evaluators)
+
+    @property
+    def parameters(self):
+        from .parameters import Parameters
+
+        p = Parameters()
+        p.attach(self.core)
+        return p

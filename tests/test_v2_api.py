@@ -1,0 +1,151 @@
+"""End-to-end v2-API tests — the SURVEY §7 minimum slice.
+
+Mirrors ``test_TrainerOnePass.cpp`` (real trainer over sample data, cost
+decreases) and v2 API tests (``python/paddle/v2/tests``).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.trainer import events as ev
+
+
+def test_mnist_mlp_trains():
+    with config_scope():
+        images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+        label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+        h1 = paddle.layer.fc(images, size=64, act=paddle.activation.Relu())
+        h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+        probs = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(probs, label)
+
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Momentum(
+                learning_rate=0.05, momentum=0.9))
+
+        costs = []
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                costs.append(event.metrics["cost"])
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(paddle.dataset.mnist.train(n_synth=512),
+                                  1024, seed=0), 64)
+        from paddle_tpu.utils import FLAGS
+
+        FLAGS.set("save_dir", "")
+        trainer.train(reader, num_passes=4, event_handler=handler,
+                      feeding={"pixel": 0, "label": 1})
+        assert costs[-1] < costs[0] * 0.6, costs
+
+        # evaluator path
+        metrics = trainer.test(
+            paddle.reader.batch(paddle.dataset.mnist.test(n_synth=128), 64),
+            feeding={"pixel": 0, "label": 1},
+            evaluators=[paddle.evaluator.classification_error()])
+        assert "classification_error" in metrics
+        assert metrics["classification_error"] < 0.9
+
+
+def test_uci_housing_regression():
+    with config_scope():
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+        y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+        pred = paddle.layer.fc(x, size=1, act=paddle.activation.Linear())
+        cost = paddle.layer.square_error_cost(pred, y)
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+        costs = []
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                costs.append(event.metrics["cost"])
+
+        from paddle_tpu.utils import FLAGS
+
+        FLAGS.set("save_dir", "")
+        reader = paddle.reader.batch(paddle.dataset.uci_housing.train(), 32)
+        trainer.train(reader, num_passes=12, event_handler=handler,
+                      feeding={"x": 0, "y": 1})
+        assert costs[-1] < costs[0] * 0.3, costs
+
+
+def test_sequence_lstm_classification():
+    """Stacked-LSTM-style sentiment classifier on synthetic IMDB."""
+    with config_scope():
+        word = paddle.layer.data(
+            "word", paddle.data_type.integer_value_sequence(200))
+        label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+        emb = paddle.layer.embedding(word, size=16)
+        lstm = paddle.networks.simple_lstm(emb, size=16)
+        pooled = paddle.layer.pooling(lstm, paddle.pooling.Max())
+        probs = paddle.layer.fc(pooled, size=2,
+                                act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(probs, label)
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(learning_rate=0.01))
+
+        def synth():
+            rng = np.random.RandomState(3)
+            for _ in range(128):
+                y = int(rng.randint(2))
+                length = int(rng.randint(4, 12))
+                lo, hi = (2, 100) if y == 0 else (100, 198)
+                yield rng.randint(lo, hi, length), y
+
+        costs = []
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                costs.append(event.metrics["cost"])
+
+        from paddle_tpu.utils import FLAGS
+
+        FLAGS.set("save_dir", "")
+        reader = paddle.reader.batch(synth, 32)
+        trainer.train(reader, num_passes=8, event_handler=handler,
+                      feeding={"word": 0, "label": 1})
+        assert costs[-1] < costs[0] * 0.5, costs
+
+
+def test_inference_api():
+    with config_scope():
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        out = paddle.layer.fc(x, size=3, act=paddle.activation.Softmax())
+        inf = paddle.inference.Inference(out)
+        batch = [[np.ones(4, np.float32)] for _ in range(5)]
+        from paddle_tpu.data.feeder import DataFeeder, dense_vector
+
+        feeder = DataFeeder([("x", dense_vector(4))])
+        probs = inf.infer([feeder.convert(batch)])
+        assert probs.shape == (5, 3)
+        np.testing.assert_allclose(probs.sum(-1), np.ones(5), rtol=1e-5)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    with config_scope():
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+        pred = paddle.layer.fc(x, size=1)
+        cost = paddle.layer.square_error_cost(pred, y)
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.SGD(learning_rate=0.1))
+        feed = {"x": np.ones((4, 4), np.float32),
+                "y": np.zeros((4, 1), np.float32)}
+        import jax.numpy as jnp
+
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        trainer.core.train_one_batch(feed)
+        path = trainer.core.save(str(tmp_path), 0)
+
+        trainer2 = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.SGD(learning_rate=0.1))
+        trainer2.core.load(path)
+        for k in trainer.core.params:
+            np.testing.assert_allclose(
+                np.asarray(trainer.core.params[k]),
+                np.asarray(trainer2.core.params[k]))
+        assert trainer2.core.samples_seen == trainer.core.samples_seen
