@@ -1,0 +1,30 @@
+"""Text model builders.
+
+``lstm_text_classifier`` mirrors the reference's RNN benchmark config
+(``benchmark/paddle/rnn/rnn.py``: data → embedding(128) → N × simple_lstm
+→ last_seq → fc softmax → classification_cost) — the workload behind the
+LSTM rows of ``benchmark/README.md:117-160``.
+"""
+
+from __future__ import annotations
+
+from ..config import dsl
+from ..config.model_config import ModelConfig
+from ..data.feeder import integer_value, integer_value_sequence
+from ..v2.networks import simple_lstm
+
+
+def lstm_text_classifier(vocab_size: int = 30000, embed_dim: int = 128,
+                         hidden_size: int = 512, lstm_num: int = 2,
+                         num_classes: int = 2) -> ModelConfig:
+    """Build the benchmark LSTM text classifier as a ModelConfig."""
+    with dsl.config_scope():
+        net = dsl.data("data", integer_value_sequence(vocab_size))
+        net = dsl.embedding(net, size=embed_dim)
+        for i in range(lstm_num):
+            net = simple_lstm(net, size=hidden_size, name=f"lstm{i}")
+        net = dsl.last_seq(net)
+        net = dsl.fc(net, size=num_classes, act=dsl.Activation("softmax"))
+        lab = dsl.data("label", integer_value(num_classes))
+        cost = dsl.classification_cost(net, lab)
+        return dsl.topology(cost)
