@@ -405,7 +405,7 @@ mixed_layer = mixed
 
 def img_conv(input: Input, filter_size: int, num_filters: int,
              num_channels: Optional[int] = None, stride: int = 1,
-             padding: int = 1, groups: int = 1, act=None,
+             padding: int = 0, groups: int = 1, act=None,
              name: Optional[str] = None, bias_attr=True,
              param_attr: Optional[ParamAttr] = None,
              img_size: Optional[int] = None,
@@ -802,26 +802,32 @@ eos_layer = eos
 # ------------------------------------------------------------ glue layers
 
 
-def _simple(ltype: str):
+def _simple(ltype: str, size_of=None):
     def f(input: Input, name: Optional[str] = None, act=None,
           **attrs) -> LayerOutput:
         ins = _as_list(input)
-        return _add_layer(name, ltype, ins[0].size, _mk_inputs(ins), act,
+        size = size_of(ins) if size_of else ins[0].size
+        return _add_layer(name, ltype, size, _mk_inputs(ins), act,
                           False, attrs or {})
 
     f.__name__ = ltype
     return f
 
 
-interpolation_layer = _simple("interpolation")
-power_layer = _simple("power")
-scaling_layer = _simple("scaling")
+# For the weighted glue layers input 0 is the (scalar-per-row) weight and
+# input 1 carries the data, so the output size comes from input 1.
+interpolation_layer = _simple("interpolation", lambda ins: ins[1].size)
+power_layer = _simple("power", lambda ins: ins[1].size)
+scaling_layer = _simple("scaling", lambda ins: ins[1].size)
 trans_layer = _simple("trans")
 row_l2_norm_layer = _simple("row_l2_norm")
 sum_to_one_norm_layer = _simple("sum_to_one_norm")
-dot_prod_layer = _simple("dot_prod")
-out_prod_layer = _simple("out_prod")
-convex_comb_layer = _simple("convex_comb")
+dot_prod_layer = _simple("dot_prod", lambda ins: 1)
+out_prod_layer = _simple("out_prod",
+                         lambda ins: ins[0].size * ins[1].size)
+# weights [B, K] select among K vectors packed in input 1 of size K*D → D
+convex_comb_layer = _simple(
+    "convex_comb", lambda ins: ins[1].size // max(ins[0].size, 1))
 
 
 def slope_intercept(input: Input, slope: float = 1.0, intercept: float = 0.0,
@@ -1089,9 +1095,8 @@ def topology(outputs: Input,
     for o in outs:
         visit(o.name)
 
+    # needed is already topologically ordered by the DFS append order
     layers = [by_name[n] for n in needed if n in by_name]
-    order = {l.name: i for i, l in enumerate(layers)}
-    layers.sort(key=lambda l: order[l.name])
     used_groups = [sm for sm in _collector.sub_models
                    if any(ln in seen for ln in sm.layer_names)]
     return ModelConfig(

@@ -28,10 +28,14 @@ def _cache_path(*parts: str) -> str:
 
 # --------------------------------------------------------------------- mnist
 
-def _synthetic_images(n: int, side: int, classes: int, seed: int):
-    """Deterministic class-conditional blobs — learnable but non-trivial."""
+def _synthetic_images(n: int, side: int, classes: int, seed: int,
+                      proto_seed: int = 1234):
+    """Deterministic class-conditional blobs — learnable but non-trivial.
+    Prototypes come from ``proto_seed`` (shared by train/test splits so the
+    test set measures generalization); only the sample draw uses ``seed``."""
+    protos = np.random.RandomState(proto_seed).randn(
+        classes, side * side).astype(np.float32)
     rng = np.random.RandomState(seed)
-    protos = rng.randn(classes, side * side).astype(np.float32)
     labels = rng.randint(0, classes, n)
     noise = rng.randn(n, side * side).astype(np.float32) * 0.7
     imgs = np.clip(protos[labels] * 0.8 + noise, -1, 1)
@@ -109,10 +113,13 @@ def cifar10_test(n_synth: int = 512):
 # ---------------------------------------------------------------------- imdb
 
 def _synthetic_text(n: int, vocab: int, classes: int, min_len: int,
-                    max_len: int, seed: int):
-    """Class-dependent unigram distributions; label recoverable from text."""
+                    max_len: int, seed: int, proto_seed: int = 4321):
+    """Class-dependent unigram distributions; label recoverable from text.
+    Boost vocabularies come from ``proto_seed`` (shared across splits)."""
+    prng = np.random.RandomState(proto_seed)
+    class_boost = [prng.permutation(vocab)[: vocab // 4]
+                   for _ in range(classes)]
     rng = np.random.RandomState(seed)
-    class_boost = [rng.permutation(vocab)[: vocab // 4] for _ in range(classes)]
     for _ in range(n):
         y = int(rng.randint(classes))
         length = int(rng.randint(min_len, max_len + 1))

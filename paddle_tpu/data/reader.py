@@ -96,10 +96,14 @@ def buffered(reader: Reader, size: int) -> Reader:
     def buffered_reader():
         q: "queue.Queue" = queue.Queue(maxsize=size)
 
+        error: List[BaseException] = []
+
         def producer():
             try:
                 for e in reader():
                     q.put(e)
+            except BaseException as exc:  # re-raised in the consumer
+                error.append(exc)
             finally:
                 q.put(_End)
 
@@ -108,6 +112,8 @@ def buffered(reader: Reader, size: int) -> Reader:
         while True:
             e = q.get()
             if e is _End:
+                if error:
+                    raise error[0]
                 break
             yield e
 
@@ -129,9 +135,11 @@ def cache(reader: Reader) -> Reader:
 
     def cached():
         if not filled[0]:
+            fresh: List[Any] = []  # discarded if this pass stops early
             for e in reader():
-                data.append(e)
+                fresh.append(e)
                 yield e
+            data[:] = fresh
             filled[0] = True
         else:
             yield from data

@@ -55,7 +55,7 @@ def optimizer_from_config(oc: OptimizationConfig) -> Tuple[Optimizer, Callable]:
     if name in ("momentum", "sgd") and oc.momentum:
         name = "momentum"
         kw["momentum"] = oc.momentum
-    if name == "adam":
+    if name in ("adam", "adamax"):
         kw.update(beta1=oc.adam_beta1, beta2=oc.adam_beta2,
                   epsilon=oc.adam_epsilon)
     if name in ("adadelta", "rmsprop", "decayed_adagrad"):
@@ -141,13 +141,33 @@ class Trainer:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _eval_output_names(self) -> List[str]:
+        """Layers whose values evaluators should see: a declared output that
+        is a cost layer stands in for its first input (the prediction) —
+        the reference wires evaluators to the prediction layer the same way
+        (``Evaluator::eval(nn)`` reads the layer named in its config)."""
+        names: List[str] = []
+        for n in self.network.output_names:
+            lyr = self.network.layers.get(n)
+            if lyr is not None and getattr(lyr, "is_cost", False) \
+                    and lyr.conf.inputs:
+                names.append(lyr.conf.inputs[0].input_layer_name)
+            else:
+                names.append(n)
+        return names
+
     def _build_eval_step(self):
         net = self.network
+        eval_names = self._eval_output_names()
 
         def step(params, buffers, feed):
             loss, (values, _) = net.loss(params, feed, buffers,
                                          is_training=False)
-            return loss, net.outputs(values)
+            outs = dict(net.outputs(values))
+            for n in eval_names:
+                if n in values:
+                    outs[n] = values[n]
+            return loss, outs
 
         return jax.jit(step)
 
@@ -218,7 +238,11 @@ class Trainer:
             total += float(loss) * b
             n += b
             if evaluators:
-                out0 = next(iter(outputs.values()))
+                # prefer the prediction layer over the cost output
+                eval_names = self._eval_output_names()
+                out0 = outputs.get(eval_names[0]) if eval_names else None
+                if out0 is None:
+                    out0 = next(iter(outputs.values()))
                 label = feed.get(label_name)
                 for e in evaluators:
                     e.eval_batch(out0, label)

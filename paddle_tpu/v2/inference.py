@@ -41,8 +41,14 @@ class Inference:
             results.append(out[0] if len(out) == 1 else out)
         if len(results) == 1:
             return results[0]
-        return np.concatenate(results) if results and \
-            results[0].ndim > 0 else results
+        if not results:
+            return results
+        if isinstance(results[0], list):
+            # multi-output net: concatenate each output across batches
+            return [np.concatenate(per_out) if per_out[0].ndim > 0
+                    else np.asarray(per_out)
+                    for per_out in zip(*results)]
+        return np.concatenate(results) if results[0].ndim > 0 else results
 
 
 def infer(output_layer, parameters=None, input=None, feeding=None):

@@ -47,7 +47,10 @@ def test_mnist_mlp_trains():
             feeding={"pixel": 0, "label": 1},
             evaluators=[paddle.evaluator.classification_error()])
         assert "classification_error" in metrics
-        assert metrics["classification_error"] < 0.9
+        # synthetic blobs share train/test prototypes → near-perfect test
+        # accuracy; also guards the evaluator seeing the prediction layer
+        # (not the cost output, which made error ≈ chance)
+        assert metrics["classification_error"] < 0.2
 
 
 def test_uci_housing_regression():
