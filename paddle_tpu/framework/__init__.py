@@ -1,0 +1,19 @@
+"""Next-gen framework: Program IR → single-XLA-computation Executor.
+
+TPU-native equivalent of the reference's fluid precursor
+(``paddle/framework`` + ``paddle/operators`` + ``python/paddle/v2/framework``):
+``ProgramDesc/BlockDesc/OpDesc/VarDesc`` (``paddle/framework/framework.proto:33-137``)
+become a pure-Python IR; ``Executor::Run`` (``paddle/framework/executor.cc:81``),
+which interprets ops one by one with per-op kernels, becomes a **tracer** that
+lowers an entire block into ONE jitted XLA computation (SURVEY §7.8 north
+star) — op granularity exists only at trace time, XLA fuses the rest.
+"""
+
+from .program import (Program, Block, Operator, Variable, Parameter,
+                      default_main_program, default_startup_program,
+                      program_guard, unique_name)
+from .ops import OPS, register_op
+from .executor import Executor, Scope, global_scope
+from .backward import append_backward
+from . import layers, initializer, optimizer, regularizer, io, nets  # noqa: F401
+from .evaluator import Accuracy

@@ -1,0 +1,360 @@
+"""Executor: lower a Program block to ONE jitted XLA computation.
+
+The reference ``Executor::Run`` (``paddle/framework/executor.cc:81``)
+creates variables then interprets ops one-by-one, each dispatching an
+OpKernel by {DataType, Place} (``operator.h:349``).  TPU-native redesign:
+the whole block is **traced once** into a pure function
+
+    (persistables, feeds, rng) -> (fetches, updated persistables)
+
+and jit-compiled; XLA fuses across op boundaries, optimizer ops update
+parameters in-place via donated buffers, and there is no per-op dispatch at
+runtime.  Compiled programs are cached by (block, feed shapes, mode).
+
+Control flow recurses into sub-blocks as the reference does
+(``RecurrentOp``/``CondOp`` own child scopes, ``operators/recurrent_op.cc``)
+but lowers them to ``lax.scan`` / ``lax.cond`` so generation/training stay
+inside the single compiled computation.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sequence import SequenceBatch, value_of
+from ..utils import ConfigError, enforce, get_logger
+from .ops import OPS, OpContext
+from .program import Block, Operator, Program, Variable
+
+log = get_logger("executor")
+
+# ops the tracer handles itself (not in the OPS registry)
+_CONTROL = {"feed", "fetch", "recurrent", "dynamic_recurrent", "cond",
+            "rnn_memory_helper", "rnn_memory_helper_grad",
+            "save", "load", "backward",
+            "ncclInit", "ncclAllReduce", "ncclBcast", "ncclReduce"}
+
+
+class Scope:
+    """name → value store for persistable variables (``scope.h:38``)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def find(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def set(self, name: str, value) -> None:
+        self.vars[name] = value
+
+    def has(self, name: str) -> bool:
+        return self.find(name) is not None
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class _Trace:
+    """One block-lowering pass: symbolic values flow through op adapters."""
+
+    def __init__(self, block: Block, ctx: OpContext, values: Dict[str, Any]):
+        self.block = block
+        self.ctx = ctx
+        self.values = values
+        self.written_persistables: Dict[str, Any] = {}
+
+    def get(self, name: str):
+        if name in self.values:
+            return self.values[name]
+        raise ConfigError(
+            f"op input {name!r} has no value (missing feed or init?)")
+
+    def run_op(self, op: Operator) -> None:
+        if op.type in _CONTROL:
+            self._run_control(op)
+            return
+        fn = OPS.get(op.type)
+        if fn is None:
+            raise ConfigError(f"unregistered op type {op.type!r}")
+        ins = {slot: [self.get(n) for n in names]
+               for slot, names in op.inputs.items() if names}
+        outs = fn(self.ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for i, name in enumerate(names):
+                if i < len(vals) and name:
+                    self._write(name, vals[i])
+
+    def _write(self, name: str, value) -> None:
+        self.values[name] = value
+        try:
+            var = self.block.var(name)
+            if var.persistable:
+                self.written_persistables[name] = value
+        except ConfigError:
+            pass
+
+    def _run_control(self, op: Operator) -> None:
+        t = op.type
+        if t in ("rnn_memory_helper", "rnn_memory_helper_grad"):
+            src = op.input("X")[0]
+            self._write(op.output("Out")[0], self.get(src))
+        elif t in ("ncclInit",):
+            pass  # device mesh replaces communicator bootstrap
+        elif t in ("ncclAllReduce", "ncclReduce", "ncclBcast"):
+            # inside pjit/shard_map the partitioner inserts collectives;
+            # a standalone op is the identity on a replicated value
+            for slot_in, slot_out in (("X", "Out"),):
+                names_in = op.input(slot_in)
+                names_out = op.output(slot_out)
+                for ni, no in zip(names_in, names_out):
+                    self._write(no, self.get(ni))
+        elif t == "recurrent":
+            self._run_recurrent(op)
+        elif t == "cond":
+            self._run_cond(op)
+        elif t in ("feed", "fetch", "save", "load", "backward"):
+            raise ConfigError(f"{t} op must be handled by Executor.run")
+        else:
+            raise ConfigError(f"unhandled control op {t!r}")
+
+    # ---- recurrent: sub-block per timestep lowered to lax.scan ----------
+    def _run_recurrent(self, op: Operator) -> None:
+        """StaticRNN semantics (``operators/recurrent_op.cc``): sequence
+        inputs [B, T, D] are scanned over T; memories (ex-state → state)
+        carry across steps; step outputs stack back to [B, T, D]."""
+        sub = self.block.program.blocks[op.attrs["sub_block"]]
+        seq_ins = op.input("inputs")          # outer seq vars
+        inner_ins = op.attrs["inner_inputs"]  # inner per-step names
+        init_states = op.input("initial_states")
+        state_names = op.attrs["states"]          # inner state (output) name
+        ex_state_names = op.attrs["ex_states"]    # inner memory (input) name
+        out_names = op.output("outputs")
+        inner_outs = op.attrs["inner_outputs"]
+
+        seqs = [self.get(n) for n in seq_ins]
+        lengths = next((s.length for s in seqs
+                        if isinstance(s, SequenceBatch)), None)
+        xs = [value_of(s) for s in seqs]      # [B, T, D]
+        carries = [self.get(n) for n in init_states]
+        captured = dict(self.values)          # outer values visible inside
+
+        ctx = self.ctx
+
+        def step(carry, xt):
+            vals = dict(captured)
+            for name, v in zip(ex_state_names, carry):
+                vals[name] = v
+            for name, v in zip(inner_ins, xt):
+                vals[name] = v
+            tr = _Trace(sub, ctx, vals)
+            for sop in sub.ops:
+                tr.run_op(sop)
+            new_carry = [vals[n] for n in state_names]
+            outs = [vals[n] for n in inner_outs]
+            return new_carry, outs
+
+        # scan over time: move T to axis 0
+        xs_t = [jnp.moveaxis(x, 1, 0) for x in xs]
+        final, stacked = jax.lax.scan(step, carries, xs_t)
+        for name, y in zip(out_names, stacked):
+            y = jnp.moveaxis(y, 0, 1)         # [B, T, D]
+            self._write(name, SequenceBatch(y, lengths)
+                        if lengths is not None else y)
+
+    def _run_cond(self, op: Operator) -> None:
+        """``cond_op.cc``: pred selects between two sub-blocks with the
+        same output signature — lowered to ``lax.cond``."""
+        pred = value_of(self.get(op.input("Cond")[0]))
+        tb = self.block.program.blocks[op.attrs["true_block"]]
+        fb = self.block.program.blocks[op.attrs["false_block"]]
+        out_names = op.output("Out")
+        captured = dict(self.values)
+        ctx = self.ctx
+
+        def branch(blk):
+            def f(_):
+                vals = dict(captured)
+                tr = _Trace(blk, ctx, vals)
+                for sop in blk.ops:
+                    tr.run_op(sop)
+                return tuple(vals[n] for n in out_names)
+            return f
+
+        outs = jax.lax.cond(jnp.all(pred > 0), branch(tb), branch(fb),
+                            operand=None)
+        for name, v in zip(out_names, outs):
+            self._write(name, v)
+
+
+class Executor:
+    """``Executor(places)`` equivalent; one jitted computation per
+    (block, feed-signature, mode)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------- run
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Sequence = (),
+            scope: Optional[Scope] = None,
+            is_test: bool = False,
+            seed: int = 0,
+            return_numpy: bool = True) -> List[Any]:
+        from .program import default_main_program
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = dict(feed or {})
+        block = program.global_block
+
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        # host-side load ops first, save ops after compute
+        compute_ops, save_ops = [], []
+        for op in block.ops:
+            if op.type == "load":
+                _host_load(op, scope)
+            elif op.type == "save":
+                save_ops.append(op)
+            elif op.type == "feed":
+                pass  # feed dict supersedes feed ops
+            elif op.type == "fetch":
+                for n in op.input("X"):
+                    if n not in fetch_names:
+                        fetch_names.append(n)
+            else:
+                compute_ops.append(op)
+
+        # persistables the compute reads or writes
+        persist_in: Dict[str, Any] = {}
+        for b in program.blocks:
+            for name, var in b.vars.items():
+                if var.persistable and scope.has(name):
+                    persist_in[name] = scope.find(name)
+
+        feed_vals = {k: _to_device(v) for k, v in feed.items()}
+        key = self._sig(program, compute_ops, feed_vals, is_test,
+                        tuple(fetch_names), tuple(sorted(persist_in)))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(program, compute_ops, fetch_names, is_test)
+            self._cache[key] = fn
+
+        rng = jax.random.PRNGKey(seed)
+        fetches, written = fn(persist_in, feed_vals, rng)
+        for name, v in written.items():
+            scope.set(name, v)
+        for op in save_ops:
+            _host_save(op, scope)
+        if return_numpy:
+            return [_to_numpy(f) for f in fetches]
+        return list(fetches)
+
+    # ----------------------------------------------------------- build
+    def _build(self, program: Program, ops: List[Operator],
+               fetch_names: List[str], is_test: bool):
+        block = program.global_block
+
+        bi = next((i for i, op in enumerate(ops)
+                   if op.type == "backward"), None)
+
+        def fn(persist, feed_vals, rng):
+            ctx = OpContext(is_test=is_test, rng=rng)
+            init: Dict[str, Any] = {}
+            init.update(persist)
+            init.update(feed_vals)
+            if bi is None:
+                values = dict(init)
+                tr = _Trace(block, ctx, values)
+                for op in ops:
+                    tr.run_op(op)
+            else:
+                bop = ops[bi]
+                pnames = [n for n in bop.attrs["parameter_names"]
+                          if n in init]
+                loss_name = bop.attrs["loss"]
+
+                def loss_fn(pvals):
+                    v = dict(init)
+                    v.update(pvals)
+                    tr_in = _Trace(block, ctx, v)
+                    for op in ops[:bi]:
+                        tr_in.run_op(op)
+                    loss = jnp.sum(value_of(v[loss_name]))
+                    return loss, (v, tr_in.written_persistables)
+
+                (_, (values, wrote)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)({n: init[n] for n in pnames})
+                for n, g in grads.items():
+                    values[n + "@GRAD"] = g
+                tr = _Trace(block, ctx, values)
+                tr.written_persistables.update(wrote)
+                for op in ops[bi + 1:]:
+                    tr.run_op(op)
+            fetches = tuple(values[n] for n in fetch_names)
+            return fetches, tr.written_persistables
+
+        return jax.jit(fn)
+
+    @staticmethod
+    def _sig(program, ops, feed_vals, is_test, fetch_names, persist_names):
+        shapes = tuple(sorted(
+            (k, _shape_sig(v)) for k, v in feed_vals.items()))
+        return (id(program), len(ops), shapes, is_test, fetch_names,
+                persist_names)
+
+
+def _shape_sig(v) -> Tuple:
+    if isinstance(v, SequenceBatch):
+        return ("seq", tuple(v.data.shape), str(v.data.dtype))
+    arr = jnp.asarray(v)
+    return (tuple(arr.shape), str(arr.dtype))
+
+
+def _to_device(v):
+    if isinstance(v, SequenceBatch):
+        return v
+    return jnp.asarray(v)
+
+
+def _to_numpy(v):
+    if isinstance(v, SequenceBatch):
+        return np.asarray(v.data)
+    return np.asarray(v)
+
+
+def _host_save(op: Operator, scope: Scope) -> None:
+    path = op.attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    data = {n: np.asarray(value_of(scope.find(n)))
+            for n in op.input("X")}
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+
+
+def _host_load(op: Operator, scope: Scope) -> None:
+    path = op.attrs["file_path"]
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    for n in op.output("Out"):
+        enforce(n in data, f"checkpoint {path} lacks variable {n!r}")
+        scope.set(n, jnp.asarray(data[n]))

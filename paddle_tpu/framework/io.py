@@ -1,0 +1,92 @@
+"""save/load persistables + inference model export
+(``python/paddle/v2/framework/io.py``; save/load ops
+``paddle/operators/save_op.cc``/``load_op.cc``)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.sequence import value_of
+from ..utils import enforce
+from .executor import Executor, Scope, global_scope
+from .program import Program, Variable, default_main_program
+
+
+def _persistable_params(program: Program) -> List[Variable]:
+    return [p for p in program.parameters()]
+
+
+def save_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None) -> None:
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    data = {}
+    for b in program.blocks:
+        for name, var in b.vars.items():
+            if var.persistable and scope.has(name):
+                data[name] = np.asarray(value_of(scope.find(name)))
+    with open(os.path.join(dirname, "persistables.pkl"), "wb") as f:
+        pickle.dump(data, f)
+
+
+save_params = save_persistables
+
+
+def load_persistables(executor: Executor, dirname: str,
+                      main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None) -> None:
+    import jax.numpy as jnp
+    scope = scope or global_scope()
+    path = os.path.join(dirname, "persistables.pkl")
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    for name, arr in data.items():
+        scope.set(name, jnp.asarray(arr))
+
+
+load_params = load_persistables
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable],
+                         executor: Executor,
+                         main_program: Optional[Program] = None,
+                         scope: Optional[Scope] = None) -> None:
+    """Prune to the inference subgraph + save params
+    (reference: ``io.py`` save_inference_model uses ``core.prune``)."""
+    program = main_program or default_main_program()
+    pruned = program.prune([v.name for v in target_vars])
+    save_persistables(executor, dirname, program, scope)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+        "ops": [(op.type, op.inputs, op.outputs, op.attrs)
+                for op in pruned.global_block.ops],
+        "vars": {n: (tuple(v.shape), v.dtype, v.persistable, v.lod_level)
+                 for n, v in program.global_block.vars.items()},
+    }
+    with open(os.path.join(dirname, "inference_model.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         scope: Optional[Scope] = None):
+    """Returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, "inference_model.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    program = Program()
+    block = program.global_block
+    for n, (shape, dtype, persistable, lod) in meta["vars"].items():
+        v = block.create_var(name=n, shape=shape, dtype=dtype,
+                             persistable=persistable, lod_level=lod)
+    for (t, ins, outs, attrs) in meta["ops"]:
+        block.append_op(t, inputs=ins, outputs=outs, attrs=attrs)
+    load_persistables(executor, dirname, program, scope)
+    fetch_vars = [block.var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

@@ -18,6 +18,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.dtypes import current_policy
@@ -49,12 +50,14 @@ def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         (data_format, "HWIO", data_format))
+    # No preferred_element_type here: conv's transpose (grad) rule can't
+    # mix a fp32 cotangent with bf16 operands in current jax; the MXU
+    # accumulates in fp32 natively, so cast-after is equivalent.
     out = lax.conv_general_dilated(
         x, w, window_strides=_pair(stride), padding=padding,
         rhs_dilation=_pair(dilation), dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=pol.output_dtype)
-    return out
+        feature_group_count=groups)
+    return out.astype(pol.output_dtype)
 
 
 @register_op("conv2d_transpose")
@@ -84,8 +87,8 @@ def conv3d(x, w, stride=1, padding="SAME", data_format: str = "NDHWC"):
         padding = [(padding, padding)] * 3
     dn = lax.conv_dimension_numbers(x.shape, w.shape, (data_format, "DHWIO", data_format))
     return lax.conv_general_dilated(
-        x, w, window_strides=s, padding=padding, dimension_numbers=dn,
-        preferred_element_type=pol.output_dtype)
+        x, w, window_strides=s, padding=padding,
+        dimension_numbers=dn).astype(pol.output_dtype)
 
 
 def _pool(x, kind: str, window: IntOr2, stride: IntOr2, padding,
@@ -108,15 +111,20 @@ def _pool(x, kind: str, window: IntOr2, stride: IntOr2, padding,
         pads = [(0, 0)] * 4
         for ax, p in zip(spatial, padding):
             pads[ax] = _pair(p)
+    # init values MUST be python scalars: a device-array init becomes a
+    # tracer under jit and jax then can't pattern-match the max/add monoid,
+    # leaving a generic reduce_window with no autodiff rule.
     if kind == "max":
-        init, op = -jnp.inf, lax.max
-        out = lax.reduce_window(x, jnp.asarray(init, x.dtype), op, dims, strides, pads)
-        return out
+        dt = np.dtype(x.dtype)
+        # branch on integer (not floating): bf16/fp8 are numpy void types
+        init = np.iinfo(dt).min if np.issubdtype(dt, np.integer) \
+            else -np.inf
+        return lax.reduce_window(x, init, lax.max, dims, strides, pads)
     # avg: exclude padding from the divisor (cuDNN
     # CUDNN_POOLING_AVERAGE_COUNT_EXCLUDE_PADDING — reference default).
-    summed = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, dims, strides, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
     ones = jnp.ones_like(x)
-    counts = lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), lax.add, dims, strides, pads)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
     return summed / counts
 
 

@@ -228,6 +228,7 @@ class Trainer:
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         total, n = 0.0, 0
+        eval_names = self._eval_output_names() if evaluators else []
         for e in evaluators:
             e.start()
         for batch in reader():
@@ -239,7 +240,6 @@ class Trainer:
             n += b
             if evaluators:
                 # prefer the prediction layer over the cost output
-                eval_names = self._eval_output_names()
                 out0 = outputs.get(eval_names[0]) if eval_names else None
                 if out0 is None:
                     out0 = next(iter(outputs.values()))
