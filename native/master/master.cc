@@ -1,0 +1,455 @@
+// Master data-task service — C++ re-implementation of the Go master
+// (go/master/service.go): todo/pending/done/failed task queues over data
+// shards, leases with per-task timeout (checkTimeoutFunc service.go:341),
+// failure re-queue with a failure cap (processFailedTask :313), state
+// snapshot/recover (:166,207 — file-based here instead of etcd), and
+// save-model election (RequestSaveModel :481).
+//
+// Exposed as a C API (ptpu_master_*) consumed by Python over ctypes —
+// the same shape as the reference's cgo client exports
+// (go/master/c/client.go) — plus a line-protocol TCP server so remote
+// trainers can share one master without etcd.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  int id = 0;
+  std::string payload;
+  int failures = 0;
+  Clock::time_point deadline{};  // valid while pending
+};
+
+class MasterService {
+ public:
+  MasterService(double timeout_s, int failure_max, std::string snapshot_path)
+      : timeout_s_(timeout_s),
+        failure_max_(failure_max),
+        snapshot_path_(std::move(snapshot_path)) {
+    if (!snapshot_path_.empty()) Recover();
+  }
+
+  void SetDataset(const std::vector<std::string>& payloads) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (recovered_) return;  // snapshot wins, like the etcd state
+    todo_.clear();
+    pending_.clear();
+    done_.clear();
+    failed_.clear();
+    next_id_ = 0;
+    for (const auto& p : payloads) {
+      Task t;
+      t.id = next_id_++;
+      t.payload = p;
+      todo_.push_back(std::move(t));
+    }
+    epoch_done_ = false;
+  }
+
+  // 0 = task granted; 1 = wait (all leased); -1 = pass finished
+  int GetTask(std::string* payload, int* task_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    CheckTimeouts();
+    if (!todo_.empty()) {
+      Task t = std::move(todo_.front());
+      todo_.pop_front();
+      t.deadline = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                      std::chrono::duration<double>(timeout_s_));
+      *payload = t.payload;
+      *task_id = t.id;
+      pending_[t.id] = std::move(t);
+      return 0;
+    }
+    if (!pending_.empty()) return 1;
+    return -1;
+  }
+
+  int TaskFinished(int task_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return -1;
+    done_.push_back(std::move(it->second));
+    pending_.erase(it);
+    if (todo_.empty() && pending_.empty()) epoch_done_ = true;
+    SnapshotLocked();
+    return 0;
+  }
+
+  int TaskFailed(int task_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pending_.find(task_id);
+    if (it == pending_.end()) return -1;
+    ProcessFailed(std::move(it->second));
+    pending_.erase(it);
+    SnapshotLocked();
+    return 0;
+  }
+
+  // new epoch over the same shards (done+failed → todo)
+  void ResetEpoch() {
+    std::lock_guard<std::mutex> g(mu_);
+    CheckTimeouts();
+    for (auto& t : done_) {
+      t.failures = 0;
+      todo_.push_back(std::move(t));
+    }
+    done_.clear();
+    for (auto& t : failed_) {
+      t.failures = 0;
+      todo_.push_back(std::move(t));
+    }
+    failed_.clear();
+    epoch_done_ = false;
+  }
+
+  // save-model election (one trainer wins per interval)
+  int RequestSaveModel(const std::string& trainer_id, double interval_s) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto now = Clock::now();
+    if (save_owner_.empty() || now >= save_expiry_) {
+      save_owner_ = trainer_id;
+      save_expiry_ = now + std::chrono::duration_cast<Clock::duration>(
+                               std::chrono::duration<double>(interval_s));
+      return 1;
+    }
+    return save_owner_ == trainer_id ? 1 : 0;
+  }
+
+  void Counts(int* todo, int* pending, int* done, int* failed) {
+    std::lock_guard<std::mutex> g(mu_);
+    CheckTimeouts();
+    *todo = static_cast<int>(todo_.size());
+    *pending = static_cast<int>(pending_.size());
+    *done = static_cast<int>(done_.size());
+    *failed = static_cast<int>(failed_.size());
+  }
+
+  void Snapshot() {
+    std::lock_guard<std::mutex> g(mu_);
+    SnapshotLocked();
+  }
+
+  void SnapshotLocked() {  // caller holds mu_
+    if (snapshot_path_.empty()) return;
+    std::ostringstream os;
+    auto dump = [&os](const char* tag, const Task& t) {
+      os << tag << "\t" << t.id << "\t" << t.failures << "\t" << t.payload
+         << "\n";
+    };
+    for (const auto& t : todo_) dump("todo", t);
+    for (const auto& kv : pending_) dump("todo", kv.second);  // re-lease
+    for (const auto& t : done_) dump("done", t);
+    for (const auto& t : failed_) dump("failed", t);
+    std::ofstream f(snapshot_path_ + ".tmp", std::ios::trunc);
+    f << os.str();
+    f.close();
+    std::rename((snapshot_path_ + ".tmp").c_str(), snapshot_path_.c_str());
+  }
+
+  int Serve(int port);
+  void StopServer();
+  ~MasterService() { StopServer(); }
+
+ private:
+  void CheckTimeouts() {  // caller holds mu_
+    auto now = Clock::now();
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if (now >= it->second.deadline) {
+        ProcessFailed(std::move(it->second));
+        it = pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void ProcessFailed(Task t) {  // caller holds mu_
+    t.failures++;
+    if (t.failures >= failure_max_) {
+      failed_.push_back(std::move(t));
+    } else {
+      todo_.push_back(std::move(t));
+    }
+  }
+
+  void Recover() {
+    std::ifstream f(snapshot_path_);
+    if (!f.good()) return;
+    std::string line;
+    int max_id = -1;
+    while (std::getline(f, line)) {
+      std::istringstream is(line);
+      std::string tag, payload;
+      int id, failures;
+      if (!(is >> tag >> id >> failures)) continue;
+      std::getline(is, payload);
+      if (!payload.empty() && payload[0] == '\t') payload.erase(0, 1);
+      while (!payload.empty() && payload[0] == ' ') payload.erase(0, 1);
+      Task t;
+      t.id = id;
+      t.failures = failures;
+      t.payload = payload;
+      if (tag == "todo") {
+        todo_.push_back(std::move(t));
+      } else if (tag == "done") {
+        done_.push_back(std::move(t));
+      } else {
+        failed_.push_back(std::move(t));
+      }
+      if (id > max_id) max_id = id;
+    }
+    next_id_ = max_id + 1;
+    recovered_ = !todo_.empty() || !done_.empty() || !failed_.empty();
+  }
+
+  std::string HandleLine(const std::string& line);
+  std::string HandleLineImpl(const std::string& line);
+  void ServerLoop();
+
+  std::mutex mu_;
+  double timeout_s_;
+  int failure_max_;
+  std::string snapshot_path_;
+  std::deque<Task> todo_;
+  std::map<int, Task> pending_;
+  std::vector<Task> done_;
+  std::vector<Task> failed_;
+  int next_id_ = 0;
+  bool epoch_done_ = false;
+  bool recovered_ = false;
+  std::string save_owner_;
+  Clock::time_point save_expiry_{};
+
+  int server_fd_ = -1;
+  std::thread server_thread_;
+  std::atomic<bool> serving_{false};
+  std::atomic<int> active_conns_{0};
+  std::mutex conn_mu_;
+  std::vector<int> conn_fds_;
+};
+
+// ---- line protocol: one request per line, tab-separated -----------------
+// GET                     -> OK\t<id>\t<payload> | WAIT | DONE
+// FIN\t<id>               -> OK | ERR
+// FAIL\t<id>              -> OK | ERR
+// SET\t<p1>\x1f<p2>...    -> OK
+// RESET                   -> OK
+// SAVE\t<trainer>\t<sec>  -> 1 | 0
+// COUNTS                  -> <todo>\t<pending>\t<done>\t<failed>
+std::string MasterService::HandleLine(const std::string& line) {
+  try {
+    return HandleLineImpl(line);
+  } catch (const std::exception& e) {
+    // a malformed request must never take down the service
+    return std::string("ERR\t") + e.what();
+  }
+}
+
+std::string MasterService::HandleLineImpl(const std::string& line) {
+  std::istringstream is(line);
+  std::string cmd;
+  std::getline(is, cmd, '\t');
+  if (cmd == "GET") {
+    std::string payload;
+    int id;
+    int rc = GetTask(&payload, &id);
+    if (rc == 0)
+      return "OK\t" + std::to_string(id) + "\t" + payload;
+    return rc == 1 ? "WAIT" : "DONE";
+  }
+  if (cmd == "FIN" || cmd == "FAIL") {
+    std::string id_s;
+    std::getline(is, id_s, '\t');
+    int rc = cmd == "FIN" ? TaskFinished(std::stoi(id_s))
+                          : TaskFailed(std::stoi(id_s));
+    return rc == 0 ? "OK" : "ERR";
+  }
+  if (cmd == "SET") {
+    std::string rest;
+    std::getline(is, rest);
+    std::vector<std::string> payloads;
+    std::istringstream ps(rest);
+    std::string p;
+    while (std::getline(ps, p, '\x1f')) payloads.push_back(p);
+    SetDataset(payloads);
+    return "OK";
+  }
+  if (cmd == "RESET") {
+    ResetEpoch();
+    return "OK";
+  }
+  if (cmd == "SAVE") {
+    std::string trainer, sec;
+    std::getline(is, trainer, '\t');
+    std::getline(is, sec, '\t');
+    return std::to_string(RequestSaveModel(trainer, std::stod(sec)));
+  }
+  if (cmd == "COUNTS") {
+    int a, b, c, d;
+    Counts(&a, &b, &c, &d);
+    std::ostringstream os;
+    os << a << "\t" << b << "\t" << c << "\t" << d;
+    return os.str();
+  }
+  return "ERR\tunknown command";
+}
+
+void MasterService::ServerLoop() {
+  while (serving_) {
+    int fd = accept(server_fd_, nullptr, nullptr);
+    if (fd < 0) break;
+    {
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(fd);
+    }
+    active_conns_++;
+    std::thread([this, fd]() {
+      std::string buf;
+      char chunk[4096];
+      while (serving_) {
+        ssize_t n = read(fd, chunk, sizeof(chunk));
+        if (n <= 0) break;
+        buf.append(chunk, n);
+        size_t pos;
+        while ((pos = buf.find('\n')) != std::string::npos) {
+          std::string line = buf.substr(0, pos);
+          buf.erase(0, pos + 1);
+          if (!line.empty() && line.back() == '\r') line.pop_back();
+          std::string resp = HandleLine(line) + "\n";
+          ssize_t off = 0;
+          while (off < static_cast<ssize_t>(resp.size())) {
+            ssize_t w = write(fd, resp.data() + off, resp.size() - off);
+            if (w <= 0) {
+              close(fd);
+              active_conns_--;
+              return;
+            }
+            off += w;
+          }
+        }
+      }
+      close(fd);
+      active_conns_--;
+    }).detach();
+  }
+}
+
+int MasterService::Serve(int port) {
+  server_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (server_fd_ < 0) return -1;
+  int opt = 1;
+  setsockopt(server_fd_, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(server_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    return -1;
+  if (listen(server_fd_, 64) < 0) return -1;
+  if (port == 0) {
+    socklen_t len = sizeof(addr);
+    getsockname(server_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+  }
+  serving_ = true;
+  server_thread_ = std::thread([this]() { ServerLoop(); });
+  return port;
+}
+
+void MasterService::StopServer() {
+  if (serving_) {
+    serving_ = false;
+    shutdown(server_fd_, SHUT_RDWR);
+    close(server_fd_);
+    {
+      // unblock every handler thread so none touches us after delete
+      std::lock_guard<std::mutex> g(conn_mu_);
+      for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);
+    }
+    if (server_thread_.joinable()) server_thread_.join();
+    while (active_conns_.load() > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpu_master_create(double timeout_s, int failure_max,
+                         const char* snapshot_path) {
+  return new MasterService(timeout_s, failure_max,
+                           snapshot_path ? snapshot_path : "");
+}
+
+void ptpu_master_destroy(void* h) { delete static_cast<MasterService*>(h); }
+
+void ptpu_master_set_dataset(void* h, const char** payloads, int n) {
+  std::vector<std::string> v(payloads, payloads + n);
+  static_cast<MasterService*>(h)->SetDataset(v);
+}
+
+// returns 0 granted / 1 wait / -1 done; payload copied into buf
+int ptpu_master_get_task(void* h, char* buf, int buflen, int* task_id) {
+  std::string payload;
+  int rc = static_cast<MasterService*>(h)->GetTask(&payload, task_id);
+  if (rc == 0) {
+    std::snprintf(buf, buflen, "%s", payload.c_str());
+  }
+  return rc;
+}
+
+int ptpu_master_task_finished(void* h, int task_id) {
+  return static_cast<MasterService*>(h)->TaskFinished(task_id);
+}
+
+int ptpu_master_task_failed(void* h, int task_id) {
+  return static_cast<MasterService*>(h)->TaskFailed(task_id);
+}
+
+void ptpu_master_reset_epoch(void* h) {
+  static_cast<MasterService*>(h)->ResetEpoch();
+}
+
+int ptpu_master_request_save_model(void* h, const char* trainer_id,
+                                   double interval_s) {
+  return static_cast<MasterService*>(h)->RequestSaveModel(trainer_id,
+                                                          interval_s);
+}
+
+void ptpu_master_counts(void* h, int* todo, int* pending, int* done,
+                        int* failed) {
+  static_cast<MasterService*>(h)->Counts(todo, pending, done, failed);
+}
+
+void ptpu_master_snapshot(void* h) {
+  static_cast<MasterService*>(h)->Snapshot();
+}
+
+// start loopback TCP server; returns bound port (or -1)
+int ptpu_master_serve(void* h, int port) {
+  return static_cast<MasterService*>(h)->Serve(port);
+}
+
+}  // extern "C"

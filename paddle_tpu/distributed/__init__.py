@@ -1,0 +1,16 @@
+"""Distributed runtime: the gen-2 (Go master/pserver) equivalents.
+
+- :mod:`master`: C++ data-task service (leases, failure re-queue,
+  snapshot/recover, save-model election) over ctypes, plus a TCP client —
+  replaces ``go/master`` + etcd.
+- :mod:`elastic`: preemption-tolerant checkpointed training loop —
+  replaces the stateless-trainer + checkpointing pserver story
+  (``doc/design/cluster_train/README.md``).
+
+The parameter-server *gradient* path has no equivalent by design: gradient
+exchange is ICI all-reduce inside the jitted train step (SURVEY §2.5 →
+TPU mapping, BASELINE north star).
+"""
+
+from .master import Master, MasterClient, master_reader  # noqa: F401
+from .elastic import ElasticTrainer  # noqa: F401

@@ -1,0 +1,75 @@
+"""Elastic, preemption-tolerant training loop.
+
+Re-expresses the gen-2 fault-tolerance story
+(``doc/design/cluster_train/README.md``): trainers are stateless — data
+progress lives in the master's leased task queue (snapshot/recover),
+model+optimizer state lives in periodic checkpoints (the Go pserver's
+``parameterCheckpoint``, ``go/pserver/service.go:146``).  Kill any trainer
+at any point; a restart recovers the latest checkpoint and the master
+re-leases unfinished shards.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from ..trainer.trainer import Trainer
+from ..utils import get_logger
+from .master import Master, MasterClient, master_reader
+
+log = get_logger("elastic")
+
+
+class ElasticTrainer:
+    """Wraps a :class:`Trainer` with master-driven data tasks and
+    periodic checkpoints; safe to kill+restart at any batch."""
+
+    def __init__(self, trainer: Trainer, client, load_fn: Callable,
+                 save_dir: str, trainer_id: str = "trainer-0",
+                 checkpoint_every_s: float = 60.0):
+        self.trainer = trainer
+        self.client = client
+        self.load_fn = load_fn
+        self.save_dir = save_dir
+        self.trainer_id = trainer_id
+        self.checkpoint_every_s = checkpoint_every_s
+        self._last_ckpt = 0.0
+
+    def resume(self) -> bool:
+        """Load the latest checkpoint if one exists."""
+        ok = self.trainer.resume(self.save_dir)
+        if ok:
+            log.info("resumed from checkpoint in %s "
+                     "(samples_seen=%d)", self.save_dir,
+                     self.trainer.samples_seen)
+        return ok
+
+    def _maybe_checkpoint(self, epoch: int, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_ckpt < self.checkpoint_every_s:
+            return
+        # save-model election: exactly one trainer checkpoints per window
+        if self.client.request_save_model(self.trainer_id,
+                                          self.checkpoint_every_s):
+            self.trainer.save(self.save_dir, epoch)
+            self._last_ckpt = now
+
+    def train(self, feeder, batch_size: int, num_epochs: int = 1,
+              event_handler: Optional[Callable] = None) -> None:
+        from ..data.reader import batch as batch_reader
+
+        self.resume()
+        for epoch in range(num_epochs):
+            reader = batch_reader(
+                master_reader(self.client, self.load_fn), batch_size)
+            for samples in reader():
+                feed = feeder.convert(samples) if feeder else samples
+                loss = self.trainer.train_one_batch(feed)
+                self._maybe_checkpoint(epoch)
+                if event_handler is not None:
+                    event_handler(epoch, loss)
+            self._maybe_checkpoint(epoch, force=True)
+            self.client.reset_epoch()
+            log.info("epoch %d complete: %s", epoch, self.client.counts())

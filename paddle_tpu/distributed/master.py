@@ -1,0 +1,204 @@
+"""Python bindings for the C++ master service (``native/master/master.cc``).
+
+Two transports, mirroring the reference's two paths:
+- in-process via ctypes (like ``go/master/c/client.go`` cgo exports used
+  through ``python/paddle/v2/master/client.py``),
+- TCP line protocol for multi-process trainers (replaces Go RPC + etcd
+  discovery — address is passed explicitly, no external coordinator).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils import PaddleTpuError, enforce, get_logger
+
+log = get_logger("master")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE = os.path.join(_REPO, "native")
+_SO = os.path.join(_NATIVE, "build", "libptpu_master.so")
+
+_lib = None
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        log.info("building native master library…")
+        subprocess.run(["make", "-C", _NATIVE], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(_SO)
+    lib.ptpu_master_create.restype = ctypes.c_void_p
+    lib.ptpu_master_create.argtypes = [ctypes.c_double, ctypes.c_int,
+                                       ctypes.c_char_p]
+    lib.ptpu_master_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptpu_master_set_dataset.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.ptpu_master_get_task.restype = ctypes.c_int
+    lib.ptpu_master_get_task.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
+    lib.ptpu_master_task_finished.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_master_task_failed.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_master_reset_epoch.argtypes = [ctypes.c_void_p]
+    lib.ptpu_master_request_save_model.restype = ctypes.c_int
+    lib.ptpu_master_request_save_model.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+    lib.ptpu_master_counts.argtypes = [ctypes.c_void_p] + \
+        [ctypes.POINTER(ctypes.c_int)] * 4
+    lib.ptpu_master_snapshot.argtypes = [ctypes.c_void_p]
+    lib.ptpu_master_serve.restype = ctypes.c_int
+    lib.ptpu_master_serve.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+class Master:
+    """In-process master (``go/master/service.go`` Service equivalent)."""
+
+    def __init__(self, timeout_s: float = 60.0, failure_max: int = 3,
+                 snapshot_path: str = ""):
+        self._lib = _load_lib()
+        self._h = self._lib.ptpu_master_create(
+            timeout_s, failure_max,
+            snapshot_path.encode() if snapshot_path else None)
+
+    def set_dataset(self, payloads: Sequence[str]) -> None:
+        arr = (ctypes.c_char_p * len(payloads))(
+            *[p.encode() for p in payloads])
+        self._lib.ptpu_master_set_dataset(self._h, arr, len(payloads))
+
+    def get_task(self) -> Tuple[int, Optional[str]]:
+        """Returns (rc, payload): rc 0 granted / 1 wait / -1 epoch done."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        tid = ctypes.c_int(-1)
+        rc = self._lib.ptpu_master_get_task(self._h, buf, len(buf),
+                                            ctypes.byref(tid))
+        if rc == 0:
+            return tid.value, buf.value.decode()
+        return rc, None
+
+    def task_finished(self, task_id: int) -> None:
+        self._lib.ptpu_master_task_finished(self._h, task_id)
+
+    def task_failed(self, task_id: int) -> None:
+        self._lib.ptpu_master_task_failed(self._h, task_id)
+
+    def reset_epoch(self) -> None:
+        self._lib.ptpu_master_reset_epoch(self._h)
+
+    def request_save_model(self, trainer_id: str,
+                           interval_s: float = 60.0) -> bool:
+        return bool(self._lib.ptpu_master_request_save_model(
+            self._h, trainer_id.encode(), interval_s))
+
+    def counts(self) -> dict:
+        vals = [ctypes.c_int() for _ in range(4)]
+        self._lib.ptpu_master_counts(self._h, *[ctypes.byref(v)
+                                                for v in vals])
+        return dict(zip(("todo", "pending", "done", "failed"),
+                        (v.value for v in vals)))
+
+    def snapshot(self) -> None:
+        self._lib.ptpu_master_snapshot(self._h)
+
+    def serve(self, port: int = 0) -> int:
+        """Start the loopback TCP server; returns the bound port."""
+        p = self._lib.ptpu_master_serve(self._h, port)
+        enforce(p > 0, "master serve failed")
+        return p
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptpu_master_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class MasterClient:
+    """TCP client speaking the master's line protocol (remote trainers)."""
+
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._buf = b""
+
+    def _call(self, line: str) -> str:
+        self._sock.sendall(line.encode() + b"\n")
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise PaddleTpuError("master connection closed")
+            self._buf += chunk
+        resp, self._buf = self._buf.split(b"\n", 1)
+        return resp.decode()
+
+    def set_dataset(self, payloads: Sequence[str]) -> None:
+        self._call("SET\t" + "\x1f".join(payloads))
+
+    def get_task(self) -> Tuple[int, Optional[str]]:
+        resp = self._call("GET")
+        if resp.startswith("OK\t"):
+            _, tid, payload = resp.split("\t", 2)
+            return int(tid), payload
+        return (1, None) if resp == "WAIT" else (-1, None)
+
+    def task_finished(self, task_id: int) -> None:
+        self._call(f"FIN\t{task_id}")
+
+    def task_failed(self, task_id: int) -> None:
+        self._call(f"FAIL\t{task_id}")
+
+    def reset_epoch(self) -> None:
+        self._call("RESET")
+
+    def request_save_model(self, trainer_id: str,
+                           interval_s: float = 60.0) -> bool:
+        return self._call(f"SAVE\t{trainer_id}\t{interval_s}") == "1"
+
+    def counts(self) -> dict:
+        vals = [int(x) for x in self._call("COUNTS").split("\t")]
+        return dict(zip(("todo", "pending", "done", "failed"), vals))
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def master_reader(client, load_fn, wait_sleep: float = 0.05):
+    """Reader pulling task payloads from a master and yielding samples —
+    the ``cloud_reader`` equivalent (``python/paddle/v2/reader/creator.py:91``).
+
+    ``load_fn(payload) -> iterable of samples``; a task is marked finished
+    only after its samples were fully consumed, failed if ``load_fn``
+    raises — so a dead trainer's lease times out and the shard is re-done
+    elsewhere (fault tolerance, ``go/master/service.go:313``).
+    """
+    import time
+
+    def reader():
+        while True:
+            tid, payload = client.get_task()
+            if payload is None:
+                if tid == 1:           # all leased elsewhere: wait
+                    time.sleep(wait_sleep)
+                    continue
+                break                   # epoch done
+            try:
+                for sample in load_fn(payload):
+                    yield sample
+            except Exception:
+                client.task_failed(tid)
+                raise
+            client.task_finished(tid)
+
+    return reader

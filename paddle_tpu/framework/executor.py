@@ -210,6 +210,7 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[Tuple, Any] = {}
+        self._run_count = 0
 
     # ------------------------------------------------------------- run
     def run(self, program: Optional[Program] = None,
@@ -259,7 +260,10 @@ class Executor:
             fn = self._build(program, compute_ops, fetch_names, is_test)
             self._cache[key] = fn
 
-        rng = jax.random.PRNGKey(seed)
+        # fold the run counter in so dropout/random ops draw fresh values
+        # every batch even with the default seed
+        self._run_count += 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._run_count)
         fetches, written = fn(persist_in, feed_vals, rng)
         for name, v in written.items():
             scope.set(name, v)

@@ -63,13 +63,24 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     program = main_program or default_main_program()
     pruned = program.prune([v.name for v in target_vars])
     save_persistables(executor, dirname, program, scope)
+
+    def _block_meta(block, ops):
+        return {
+            "parent_idx": block.parent_idx,
+            "ops": [(op.type, op.inputs, op.outputs, op.attrs)
+                    for op in ops],
+            "vars": {n: (tuple(v.shape), v.dtype, v.persistable,
+                         v.lod_level)
+                     for n, v in block.vars.items()},
+        }
+
+    # all blocks travel so recurrent/cond sub_block indices stay valid
     meta = {
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],
-        "ops": [(op.type, op.inputs, op.outputs, op.attrs)
-                for op in pruned.global_block.ops],
-        "vars": {n: (tuple(v.shape), v.dtype, v.persistable, v.lod_level)
-                 for n, v in program.global_block.vars.items()},
+        "blocks": [_block_meta(b, pruned.global_block.ops if b.idx == 0
+                               else b.ops)
+                   for b in program.blocks],
     }
     with open(os.path.join(dirname, "inference_model.pkl"), "wb") as f:
         pickle.dump(meta, f)
@@ -81,12 +92,19 @@ def load_inference_model(dirname: str, executor: Executor,
     with open(os.path.join(dirname, "inference_model.pkl"), "rb") as f:
         meta = pickle.load(f)
     program = Program()
-    block = program.global_block
-    for n, (shape, dtype, persistable, lod) in meta["vars"].items():
-        v = block.create_var(name=n, shape=shape, dtype=dtype,
+    blocks_meta = meta.get("blocks")
+    if blocks_meta is None:   # legacy single-block format
+        blocks_meta = [{"parent_idx": -1, "ops": meta["ops"],
+                        "vars": meta["vars"]}]
+    for i, bm in enumerate(blocks_meta):
+        block = program.global_block if i == 0 else \
+            program.create_block(bm["parent_idx"])
+        for n, (shape, dtype, persistable, lod) in bm["vars"].items():
+            block.create_var(name=n, shape=shape, dtype=dtype,
                              persistable=persistable, lod_level=lod)
-    for (t, ins, outs, attrs) in meta["ops"]:
-        block.append_op(t, inputs=ins, outputs=outs, attrs=attrs)
+        for (t, ins, outs, attrs) in bm["ops"]:
+            block.append_op(t, inputs=ins, outputs=outs, attrs=attrs)
     load_persistables(executor, dirname, program, scope)
-    fetch_vars = [block.var(n) for n in meta["fetch_names"]]
+    gb = program.global_block
+    fetch_vars = [gb.var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars

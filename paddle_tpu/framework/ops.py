@@ -572,8 +572,13 @@ def _crf_decoding(ctx, ins, attrs):
 def _accuracy(ctx, ins, attrs):
     out = value_of(_in(ins, "Out"))
     label = value_of(_in(ins, "Label")).reshape(-1)
-    pred = jnp.argmax(out, axis=-1)
-    correct = jnp.sum((pred == label).astype(jnp.float32))
+    k = attrs.get("k", 1)
+    if k <= 1:
+        hit = jnp.argmax(out, axis=-1) == label
+    else:
+        _, topk = lax.top_k(out, k)
+        hit = jnp.any(topk == label[:, None], axis=-1)
+    correct = jnp.sum(hit.astype(jnp.float32))
     total = jnp.asarray(label.shape[0], jnp.float32)
     return {"Accuracy": [correct / total], "Correct": [correct],
             "Total": [total]}

@@ -1,0 +1,19 @@
+"""Parallelism toolkit: mesh-axis sharding for parameters and activations.
+
+Replaces the reference's intra-node parallel machinery with GSPMD
+annotations (SURVEY §2.5 mapping):
+
+- ``MultiGradientMachine`` thread-per-GPU data parallelism
+  (``MultiGradientMachine.h:45``) → batch sharded over the ``data`` axis
+  (already the Trainer default).
+- ``ParallelNeuralNetwork`` per-layer device placement (``--parallel_nn``,
+  per-layer ``device=`` in ModelConfig) → per-parameter/activation
+  PartitionSpec rules over the ``model`` axis (:class:`ShardingRules`).
+- Sparse-remote parameter sharding (``SparseRemoteParameterUpdater``,
+  row-sparse tables on dedicated pserver ports) → embedding tables sharded
+  on the vocab dim over ``model``; the row-gather becomes an XLA
+  all-gather/dynamic-slice pair the partitioner inserts.
+"""
+
+from .sharding import (ShardingRules, tp_rules, shard_params,
+                       constraint)  # noqa: F401

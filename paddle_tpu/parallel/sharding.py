@@ -1,0 +1,85 @@
+"""Per-parameter sharding rules (regex → PartitionSpec).
+
+The reference expresses model placement imperatively (a layer's ``device=``
+attribute routes it to a compute thread, ``ParallelNeuralNetwork.h:34``).
+TPU-native: parameters get ``NamedSharding``s; XLA's SPMD partitioner
+derives activation layouts and inserts the collectives.  Rules are
+name-pattern based so they compose with any config-driven model.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.device import DATA_AXIS, MODEL_AXIS, get_mesh
+from ..utils import get_logger
+
+log = get_logger("sharding")
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = ()):
+        self.rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in rules]
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name) and len(spec) <= ndim:
+                return spec
+        return P()  # replicated
+
+    def sharding_for(self, name: str, ndim: int,
+                     mesh: Optional[Mesh] = None) -> NamedSharding:
+        mesh = mesh or get_mesh()
+        return NamedSharding(mesh, self.spec_for(name, ndim))
+
+
+def tp_rules(model_axis: str = MODEL_AXIS) -> ShardingRules:
+    """Default tensor-parallel ruleset for the layer engine's parameter
+    naming (``_<layer>.w<i>`` / ``_<layer>.wbias``):
+
+    - embedding tables: shard the vocab (row) dim — the sparse-remote
+      equivalent; lookups become gather + collective.
+    - fc/projection weights: shard the output (col) dim (Megatron-style
+      column parallel); XLA inserts the matching all-reduce.
+    - recurrent/batch-norm/bias: replicated (latency-bound, tiny).
+    """
+    return ShardingRules([
+        (r"emb|__table|lookup", P(model_axis, None)),
+        (r"\.wbias$|\.b$|bn|batch_norm", P()),
+        (r"lstm|gru|recurrent", P()),
+        (r"\.w\d*$", P(None, model_axis)),
+    ])
+
+
+def shard_params(params: Dict[str, jax.Array], rules: ShardingRules,
+                 mesh: Optional[Mesh] = None) -> Dict[str, jax.Array]:
+    """Place every parameter according to the rules (device_put with
+    NamedSharding — GSPMD propagates the rest)."""
+    mesh = mesh or get_mesh()
+    out = {}
+    for name, value in params.items():
+        leaves = jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, rules.sharding_for(name, getattr(x, "ndim", 0), mesh)),
+            value)
+        out[name] = leaves
+    return out
+
+
+def constraint(x, *spec, mesh: Optional[Mesh] = None):
+    """``with_sharding_constraint`` helper for layer authors — the
+    per-layer ``device=`` placement equivalent."""
+    mesh = mesh or get_mesh()
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))

@@ -72,8 +72,10 @@ class Trainer:
     def __init__(self, network: NeuralNetwork,
                  optimizer: Optional[Optimizer] = None,
                  opt_config: Optional[OptimizationConfig] = None,
-                 mesh=None, seed: Optional[int] = None):
+                 mesh=None, seed: Optional[int] = None,
+                 sharding_rules=None):
         self.network = network
+        self.sharding_rules = sharding_rules
         if optimizer is None:
             optimizer, self.schedule = optimizer_from_config(
                 opt_config or OptimizationConfig())
@@ -111,6 +113,37 @@ class Trainer:
             return tree
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, replicated(self.mesh)), tree)
+
+    def _place_params(self, params):
+        """Tensor-parallel placement: honor sharding_rules (per-parameter
+        PartitionSpec, ``parallel_nn`` equivalent) else replicate."""
+        if self.sharding_rules is None or self.mesh.devices.size <= 1:
+            return self._replicate(params)
+        from ..parallel.sharding import shard_params
+        return shard_params(params, self.sharding_rules, self.mesh)
+
+    def _place_opt_state(self, opt_state, params):
+        """Optimizer slots (Adam moments etc.) shard like their parameter —
+        otherwise TP's memory win is lost and XLA reshards every step."""
+        if self.sharding_rules is None or self.mesh.devices.size <= 1:
+            return self._replicate(opt_state)
+        count, slots = opt_state
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        names = [".".join(str(k.key) if hasattr(k, "key") else str(k)
+                          for k in path)
+                 for path, _ in jax.tree_util.tree_flatten_with_path(
+                     params)[0]]
+        placed_slots = []
+        for name, p, slot in zip(names, p_leaves, slots):
+            sh = self.sharding_rules.sharding_for(
+                name, getattr(p, "ndim", 0), self.mesh)
+
+            def place(x, sh=sh, pshape=np.shape(p)):
+                if np.shape(x) == pshape:
+                    return jax.device_put(x, sh)
+                return jax.device_put(x, replicated(self.mesh))
+            placed_slots.append(jax.tree_util.tree_map(place, slot))
+        return (jax.device_put(count, replicated(self.mesh)), placed_slots)
 
     @staticmethod
     def _dealias(tree):
@@ -175,8 +208,9 @@ class Trainer:
         """``TrainerInternal::trainOneBatch`` equivalent (one jit call)."""
         if self._train_step is None:
             self._train_step = self._build_train_step()
-            self.params = self._replicate(self._dealias(self.params))
-            self.opt_state = self._replicate(self._dealias(self.opt_state))
+            self.params = self._place_params(self._dealias(self.params))
+            self.opt_state = self._place_opt_state(
+                self._dealias(self.opt_state), self.params)
             self.buffers = self._replicate(self._dealias(self.buffers))
         feed = self._shard_feed(feed)
         batch = _batch_size(feed)

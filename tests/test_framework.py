@@ -208,6 +208,32 @@ def test_save_load_inference_model(tmp_path, rng):
     np.testing.assert_allclose(out, ref, rtol=1e-5)
 
 
+def test_save_load_rnn_model(tmp_path):
+    """Sub-blocks (recurrent op) must survive export/reload."""
+    main, startup = fw.Program(), fw.Program()
+    with fw.program_guard(main, startup):
+        seq = layers.data("seq", shape=[5, 3])
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(seq)
+            mem = rnn.memory(batch_ref=seq, shape=(-1, 3), init_value=0.0)
+            nxt = layers.sums([xt, mem])
+            rnn.update_memory(mem, nxt)
+            rnn.output(nxt)
+        outs = rnn()
+    exe = _startup_and_exe(startup)
+    xb = np.arange(30).reshape(2, 5, 3).astype(np.float32)
+    ref, = exe.run(main, feed={"seq": xb}, fetch_list=[outs])
+    fw.io.save_inference_model(str(tmp_path), ["seq"], [outs], exe,
+                               main_program=main)
+    sc = Scope()
+    prog, _, fetches = fw.io.load_inference_model(str(tmp_path), exe,
+                                                  scope=sc)
+    out, = exe.run(prog, feed={"seq": xb}, fetch_list=fetches, scope=sc,
+                   is_test=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
 def test_backward_matches_numeric(rng):
     """check_grad equivalent: autodiff grads vs finite differences."""
     main, startup = fw.Program(), fw.Program()
