@@ -1,0 +1,36 @@
+"""Image benchmark config: one file drives all reference image workloads
+(``benchmark/paddle/image/{alexnet,googlenet,vgg,smallnet_mnist_cifar}.py``)
+via ``--config_args model=alexnet|googlenet|vgg|smallnet|resnet``."""
+
+model = get_config_arg('model', str, 'smallnet')
+batch_size = get_config_arg('batch_size', int, 64)
+num_samples = get_config_arg('num_samples', int, 2048)
+
+dims = {'smallnet': (32, 10), 'resnet_cifar10': (32, 10),
+        'alexnet': (227, 1000), 'googlenet': (224, 1000),
+        'vgg': (224, 1000), 'resnet': (224, 1000)}
+side, num_class = dims[model]
+
+args = {'height': side, 'width': side, 'color': True,
+        'num_class': num_class, 'num_samples': num_samples}
+define_py_data_sources2(None, None, module="provider", obj="process",
+                        args=args)
+
+settings(
+    batch_size=batch_size,
+    learning_rate=0.01 / batch_size,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(0.0005 * batch_size))
+
+from paddle_tpu.models import image as M
+
+img = data('data', dense_vector(side * side * 3), height=side, width=side)
+builders = {'smallnet': M.smallnet_mnist_cifar, 'alexnet': M.alexnet,
+            'googlenet': M.googlenet,
+            'vgg': lambda i, n: M.vgg(i, 19, n),
+            'resnet': lambda i, n: M.resnet(i, 50, n),
+            'resnet_cifar10': lambda i, n: M.resnet_cifar10(i, 32, n)}
+net = builders[model](img, num_class)
+lab = data('label', integer_value(num_class))
+loss = classification_cost(net, lab)
+outputs(loss)

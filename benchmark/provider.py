@@ -1,0 +1,52 @@
+"""Synthetic data providers for the benchmark configs
+(stands in for ``benchmark/paddle/image/provider.py`` /
+``benchmark/paddle/rnn/provider.py``, which generate/load real data)."""
+
+import numpy as np
+
+from paddle_tpu.data.feeder import (dense_vector, integer_value,
+                                    integer_value_sequence)
+from paddle_tpu.data.provider import provider
+
+
+def _image_types(settings, **kwargs):
+    h = kwargs.get("height", 32)
+    w = kwargs.get("width", 32)
+    c = 3 if kwargs.get("color", True) else 1
+    settings.input_types = [dense_vector(h * w * c),
+                            integer_value(kwargs.get("num_class", 10))]
+    settings.kw = kwargs
+
+
+@provider(init_hook=_image_types, should_shuffle=False)
+def process(settings, _file):
+    kw = settings.kw
+    h, w = kw.get("height", 32), kw.get("width", 32)
+    c = 3 if kw.get("color", True) else 1
+    nc = kw.get("num_class", 10)
+    n = kw.get("num_samples", 2048)
+    rng = np.random.RandomState(0)
+    for _ in range(n):
+        yield (rng.uniform(-1, 1, h * w * c).astype(np.float32),
+               int(rng.randint(nc)))
+
+
+def _rnn_types(settings, **kwargs):
+    settings.input_types = [
+        integer_value_sequence(kwargs.get("vocab_size", 30000)),
+        integer_value(2)]
+    settings.kw = kwargs
+
+
+@provider(init_hook=_rnn_types, should_shuffle=False)
+def process_rnn(settings, _file):
+    kw = settings.kw
+    vocab = kw.get("vocab_size", 30000)
+    maxlen = kw.get("maxlen", 100)
+    n = kw.get("num_samples", 2048)
+    pad = kw.get("pad_seq", True)
+    rng = np.random.RandomState(0)
+    for _ in range(n):
+        ln = maxlen if pad else int(rng.randint(maxlen // 2, maxlen + 1))
+        yield (rng.randint(0, vocab, ln).astype(np.int64).tolist(),
+               int(rng.randint(2)))

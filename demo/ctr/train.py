@@ -1,0 +1,77 @@
+"""Sparse wide&deep CTR demo (reference ``demo/ctr`` + the sparse
+large-model workload, BASELINE config 5): dense features through the wide
+path, 26 categorical slots through a large embedding table (the
+sparse-remote-parameter-equivalent — shard it over the ``model`` mesh axis
+via ``paddle_tpu.parallel.tp_rules`` on multi-chip).
+
+Run: python demo/ctr/train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.utils import FLAGS
+
+SPARSE_DIM = 10 ** 5   # demo-sized vocabulary
+SLOTS = 26
+
+
+def main():
+    FLAGS.set("save_dir", "")
+    with config_scope():
+        dense = paddle.layer.data("dense",
+                                  paddle.data_type.dense_vector(13))
+        ids = paddle.layer.data(
+            "ids", paddle.data_type.integer_value_sequence(SPARSE_DIM))
+        label = paddle.layer.data("label",
+                                  paddle.data_type.integer_value(2))
+        # deep: embed each slot, pool
+        emb = paddle.layer.embedding(ids, size=16, name="slot_emb")
+        deep_in = dsl.pooling(emb, pooling_type=dsl.SumPooling())
+        deep = paddle.layer.fc(deep_in, size=32,
+                               act=paddle.activation.Relu())
+        deep = paddle.layer.fc(deep, size=32,
+                               act=paddle.activation.Relu())
+        # wide: dense straight through
+        wide = paddle.layer.fc(dense, size=16,
+                               act=paddle.activation.Relu())
+        probs = paddle.layer.fc([deep, wide], size=2,
+                                act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(probs, label)
+
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(
+                learning_rate=1e-3))
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: {event.metrics}")
+
+        def to_sample(raw):
+            d, sids, lab = raw
+            return d, (sids % SPARSE_DIM).tolist(), lab
+
+        src = paddle.dataset.criteo.train(n_synth=4096,
+                                          sparse_dim=SPARSE_DIM)
+        reader = paddle.reader.batch(
+            paddle.reader.map_readers(to_sample, src), 128,
+            drop_last=True)
+        trainer.train(reader, num_passes=3, event_handler=handler,
+                      feeding={"dense": 0, "ids": 1, "label": 2})
+        metrics = trainer.test(
+            reader, feeding={"dense": 0, "ids": 1, "label": 2},
+            evaluators=[paddle.evaluator.classification_error()])
+        print("test:", metrics)
+        return 0 if metrics["classification_error"] < 0.45 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Image-classification demo (reference ``demo/image_classification`` —
+VGG/ResNet on CIFAR): resnet_cifar10 on the synthetic CIFAR dataset.
+
+Run: python demo/image_classification/train.py [--model resnet|vgg]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.models import image as M
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.utils import FLAGS
+
+
+def main():
+    FLAGS.set("save_dir", "")
+    model = "vgg" if "--model" in sys.argv and \
+        sys.argv[sys.argv.index("--model") + 1] == "vgg" else "resnet"
+    with config_scope():
+        img = paddle.layer.data("image",
+                                paddle.data_type.dense_vector(3072),
+                                height=32, width=32)
+        label = paddle.layer.data("label",
+                                  paddle.data_type.integer_value(10))
+        if model == "resnet":
+            probs = M.resnet_cifar10(img, depth=20, num_classes=10)
+        else:
+            from paddle_tpu.v2.networks import vgg_16_network
+            probs = vgg_16_network(img, num_channels=3, num_classes=10)
+        cost = paddle.layer.classification_cost(probs, label)
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9))
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: {event.metrics}")
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(paddle.dataset.cifar.train10(), 4096,
+                                  seed=0), 64, drop_last=True)
+        trainer.train(reader, num_passes=3, event_handler=handler,
+                      feeding={"image": 0, "label": 1})
+        metrics = trainer.test(
+            paddle.reader.batch(paddle.dataset.cifar.test10(), 64,
+                                drop_last=True),
+            feeding={"image": 0, "label": 1},
+            evaluators=[paddle.evaluator.classification_error()])
+        print("test:", metrics)
+        return 0 if metrics["classification_error"] < 0.4 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""MNIST demo (reference ``v1_api_demo/mnist``): MLP via the v2 API.
+
+Run: python demo/mnist/train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.utils import FLAGS
+
+
+def main():
+    FLAGS.set("save_dir", "")
+    with config_scope():
+        images = paddle.layer.data("pixel",
+                                   paddle.data_type.dense_vector(784))
+        label = paddle.layer.data("label",
+                                  paddle.data_type.integer_value(10))
+        h1 = paddle.layer.fc(images, size=128,
+                             act=paddle.activation.Relu())
+        h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+        probs = paddle.layer.fc(h2, size=10,
+                                act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(probs, label)
+
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Momentum(
+                learning_rate=0.05, momentum=0.9))
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: {event.metrics}")
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(paddle.dataset.mnist.train(), 8192,
+                                  seed=0), 64)
+        trainer.train(reader, num_passes=5, event_handler=handler,
+                      feeding={"pixel": 0, "label": 1})
+        metrics = trainer.test(
+            paddle.reader.batch(paddle.dataset.mnist.test(), 64),
+            feeding={"pixel": 0, "label": 1},
+            evaluators=[paddle.evaluator.classification_error()])
+        print("test:", metrics)
+        return 0 if metrics["classification_error"] < 0.1 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

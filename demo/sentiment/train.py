@@ -1,0 +1,59 @@
+"""Sentiment demo (reference ``demo/sentiment`` / v2 IMDB): stacked
+bidirectional LSTM text classifier over variable-length sequences.
+
+Run: python demo/sentiment/train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.utils import FLAGS
+from paddle_tpu.v2.networks import stacked_lstm_net
+
+
+def main():
+    FLAGS.set("save_dir", "")
+    word_dict = paddle.dataset.imdb.word_dict()
+    with config_scope():
+        data = paddle.layer.data(
+            "word", paddle.data_type.integer_value_sequence(len(word_dict)))
+        label = paddle.layer.data("label",
+                                  paddle.data_type.integer_value(2))
+        emb = paddle.layer.embedding(data, size=64)
+        lstm_last = dsl.last_seq(stacked_lstm_net(emb, hid_dim=64,
+                                                  stacked_num=3))
+        probs = paddle.layer.fc(lstm_last, size=2,
+                                act=paddle.activation.Softmax())
+        cost = paddle.layer.classification_cost(probs, label)
+
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(
+                learning_rate=2e-3))
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: {event.metrics}")
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(
+                paddle.dataset.imdb.train(word_dict), 2048, seed=0), 32,
+            drop_last=True)
+        trainer.train(reader, num_passes=3, event_handler=handler,
+                      feeding={"word": 0, "label": 1})
+        metrics = trainer.test(
+            paddle.reader.batch(paddle.dataset.imdb.test(word_dict), 32,
+                                drop_last=True),
+            feeding={"word": 0, "label": 1},
+            evaluators=[paddle.evaluator.classification_error()])
+        print("test:", metrics)
+        return 0 if metrics["classification_error"] < 0.3 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""Seq2seq + attention NMT demo (reference ``demo/seqToseq`` /
+``v2 wmt14``): bidirectional GRU encoder, Bahdanau attention decoder
+trained teacher-forced, then beam-search generation sharing weights.
+
+Synthetic task: "translate" = reverse the source sequence.  After a short
+training run the generator must emit reversed sources — proving encoder,
+attention, recurrent-group training and beam-search generation end-to-end.
+
+Run: python demo/seqToseq/train.py [--quick]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import (GeneratedInput, ParamAttr, StaticInput,
+                                   StepInput, config_scope)
+from paddle_tpu.config.model_config import OptimizationConfig
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.data.feeder import integer_value_sequence
+from paddle_tpu.layers.network import NeuralNetwork
+from paddle_tpu.trainer.trainer import Trainer
+from paddle_tpu.v2.networks import simple_attention, simple_gru
+
+VOCAB, EMB, HID = 32, 16, 48
+BOS, EOS = 0, 1
+SRC_LEN = 6
+
+
+def encoder(src):
+    src_emb = dsl.embedding(src, size=EMB, name="src_emb",
+                            param_attr=ParamAttr(name="_src_emb"),
+                            vocab_size=VOCAB)
+    fwd = simple_gru(src_emb, size=HID, name="enc_fwd")
+    bwd = simple_gru(src_emb, size=HID, name="enc_bwd", reverse=True)
+    enc = dsl.concat([fwd, bwd], name="enc_seq")
+    enc_proj = dsl.fc(enc, size=HID, act=dsl.LinearActivation(),
+                      bias_attr=False, name="enc_proj")
+    boot = dsl.fc(dsl.last_seq(bwd), size=HID, act=dsl.TanhActivation(),
+                  name="dec_boot")
+    return enc, enc_proj, boot
+
+
+def decoder_step(enc, enc_proj, boot, trg_word):
+    mem = dsl.memory(name="dec_gru", size=HID, boot_layer=boot)
+    context = simple_attention(enc, enc_proj, mem.out, name="att")
+    inp = dsl.fc([context, trg_word], size=HID * 3,
+                 act=dsl.LinearActivation(), bias_attr=False,
+                 name="dec_inproj")
+    hidden = dsl.gru_step_layer(inp, mem.out, size=HID, name="dec_gru")
+    return dsl.fc(hidden, size=VOCAB, act=dsl.SoftmaxActivation(),
+                  name="dec_prob")
+
+
+def build_train():
+    with config_scope():
+        src = dsl.data("src", integer_value_sequence(VOCAB))
+        trg_in = dsl.data("trg_in", integer_value_sequence(VOCAB))
+        trg_lbl = dsl.data("trg_lbl", integer_value_sequence(VOCAB))
+        enc, enc_proj, boot = encoder(src)
+        trg_emb = dsl.embedding(trg_in, size=EMB, name="trg_emb",
+                                param_attr=ParamAttr(name="_trg_emb"),
+                                vocab_size=VOCAB)
+
+        def step(e, ep, b, w):
+            return decoder_step(e, ep, b, w)
+
+        out = dsl.recurrent_group(
+            step, [enc, enc_proj, boot, StepInput(trg_emb)],
+            name="dec_group")
+        cost = dsl.classification_cost(out, trg_lbl)
+        return dsl.topology(cost)
+
+
+def build_gen(beam_size=4, max_length=SRC_LEN + 2):
+    with config_scope():
+        src = dsl.data("src", integer_value_sequence(VOCAB))
+        enc, enc_proj, boot = encoder(src)
+        gen = dsl.beam_search(
+            lambda e, ep, b, w: decoder_step(e, ep, b, w),
+            input=[StaticInput(enc), StaticInput(enc_proj),
+                   StaticInput(boot),
+                   GeneratedInput(size=VOCAB, embedding_name="_trg_emb",
+                                  embedding_size=EMB)],
+            bos_id=BOS, eos_id=EOS, beam_size=beam_size,
+            max_length=max_length)
+        return dsl.topology(gen), gen
+
+
+def batches(rng, n, bs=16):
+    for _ in range(n):
+        src = rng.randint(2, VOCAB, (bs, SRC_LEN)).astype(np.int32)
+        trg = src[:, ::-1]
+        tin = np.concatenate([np.full((bs, 1), BOS, np.int32), trg],
+                             axis=1)
+        tlb = np.concatenate([trg, np.full((bs, 1), EOS, np.int32)],
+                             axis=1)
+        lens_s = np.full((bs,), SRC_LEN, np.int32)
+        lens_t = np.full((bs,), SRC_LEN + 1, np.int32)
+        yield {"src": SequenceBatch(jnp.asarray(src), jnp.asarray(lens_s)),
+               "trg_in": SequenceBatch(jnp.asarray(tin),
+                                       jnp.asarray(lens_t)),
+               "trg_lbl": SequenceBatch(jnp.asarray(tlb),
+                                        jnp.asarray(lens_t))}
+
+
+def main():
+    quick = "--quick" in sys.argv
+    steps = 120 if quick else 600
+    rng = np.random.RandomState(0)
+    net = NeuralNetwork(build_train())
+    trainer = Trainer(net, opt_config=OptimizationConfig(
+        learning_method="adam", learning_rate=0.01,
+        gradient_clipping_threshold=5.0), seed=1)
+    loss = None
+    for i, feed in enumerate(batches(rng, steps)):
+        loss = trainer.train_one_batch(feed)
+        if i % 50 == 0:
+            print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    print(f"final loss: {float(loss):.4f}")
+
+    gen_cfg, gen = build_gen()
+    gnet = NeuralNetwork(gen_cfg)
+    gparams = gnet.init_params(seed=0)
+    missing = set(gparams) - set(trainer.params)
+    assert not missing, f"generation params missing from training: {missing}"
+    shared = {k: trainer.params[k] for k in gparams}
+
+    src = rng.randint(2, VOCAB, (4, SRC_LEN)).astype(np.int32)
+    feed = {"src": SequenceBatch(
+        jnp.asarray(src), jnp.asarray(np.full((4,), SRC_LEN, np.int32)))}
+    values, _ = gnet.forward(shared, feed, {}, is_training=False)
+    ids = np.asarray(values[gen.name])[:, 0, :]
+    lengths = np.asarray(values[f"{gen.name}.lengths"])[:, 0]
+    correct = 0
+    for b in range(4):
+        want = list(src[b, ::-1]) + [EOS]
+        got = list(ids[b, :lengths[b]])
+        ok = got == want
+        correct += ok
+        print(f"src={list(src[b])} → gen={got} "
+              f"{'OK' if ok else f'(want {want})'}")
+    print(f"beam-search generation: {correct}/4 exact reversals")
+    return 0 if (quick or correct >= 3) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@
 #include <fstream>
 #include <map>
 #include <mutex>
+#include <set>
 #include <sstream>
 #include <string>
 #include <thread>
@@ -64,7 +65,7 @@ class MasterService {
       t.payload = p;
       todo_.push_back(std::move(t));
     }
-    epoch_done_ = false;
+
   }
 
   // 0 = task granted; 1 = wait (all leased); -1 = pass finished
@@ -91,7 +92,6 @@ class MasterService {
     if (it == pending_.end()) return -1;
     done_.push_back(std::move(it->second));
     pending_.erase(it);
-    if (todo_.empty() && pending_.empty()) epoch_done_ = true;
     SnapshotLocked();
     return 0;
   }
@@ -106,10 +106,13 @@ class MasterService {
     return 0;
   }
 
-  // new epoch over the same shards (done+failed → todo)
+  // new epoch over the same shards (done+failed → todo); idempotent —
+  // a second trainer's reset while work is still queued is a no-op, so
+  // N trainers draining the same queue reset exactly once per epoch
   void ResetEpoch() {
     std::lock_guard<std::mutex> g(mu_);
     CheckTimeouts();
+    if (!todo_.empty() || !pending_.empty()) return;
     for (auto& t : done_) {
       t.failures = 0;
       todo_.push_back(std::move(t));
@@ -120,7 +123,6 @@ class MasterService {
       todo_.push_back(std::move(t));
     }
     failed_.clear();
-    epoch_done_ = false;
   }
 
   // save-model election (one trainer wins per interval)
@@ -236,7 +238,7 @@ class MasterService {
   std::vector<Task> done_;
   std::vector<Task> failed_;
   int next_id_ = 0;
-  bool epoch_done_ = false;
+
   bool recovered_ = false;
   std::string save_owner_;
   Clock::time_point save_expiry_{};
@@ -246,7 +248,7 @@ class MasterService {
   std::atomic<bool> serving_{false};
   std::atomic<int> active_conns_{0};
   std::mutex conn_mu_;
-  std::vector<int> conn_fds_;
+  std::set<int> conn_fds_;
 };
 
 // ---- line protocol: one request per line, tab-separated -----------------
@@ -321,10 +323,20 @@ void MasterService::ServerLoop() {
     if (fd < 0) break;
     {
       std::lock_guard<std::mutex> g(conn_mu_);
-      conn_fds_.push_back(fd);
+      conn_fds_.insert(fd);
     }
     active_conns_++;
     std::thread([this, fd]() {
+      auto done = [this, fd]() {
+        close(fd);
+        {
+          // drop the fd so StopServer never shuts down a number the OS
+          // has since reassigned to an unrelated socket
+          std::lock_guard<std::mutex> g(conn_mu_);
+          conn_fds_.erase(fd);
+        }
+        active_conns_--;
+      };
       std::string buf;
       char chunk[4096];
       while (serving_) {
@@ -341,16 +353,14 @@ void MasterService::ServerLoop() {
           while (off < static_cast<ssize_t>(resp.size())) {
             ssize_t w = write(fd, resp.data() + off, resp.size() - off);
             if (w <= 0) {
-              close(fd);
-              active_conns_--;
+              done();
               return;
             }
             off += w;
           }
         }
       }
-      close(fd);
-      active_conns_--;
+      done();
     }).detach();
   }
 }

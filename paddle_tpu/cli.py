@@ -1,0 +1,144 @@
+"""``paddle`` CLI — the ``paddle train`` driver
+(``paddle/trainer/TrainerMain.cpp:32`` + ``paddle/scripts/submit_local.sh.in``).
+
+Jobs: train / test / time / checkgrad (``--job=``, ``Trainer.cpp:299``,
+``TrainerBenchmark.cpp``), plus ``version``.  Config files use the v1
+protocol (see :mod:`paddle_tpu.config.config_parser`).
+
+Usage:
+    python -m paddle_tpu train --config=conf.py --job=time \
+        --config_args batch_size=64 --num_passes=2 --save_dir=./out
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from .utils import FLAGS, get_logger
+
+log = get_logger("cli")
+
+
+def _build_reader(ds, opt, test: bool = False):
+    """Data source spec → batched reader (PyDataProvider2 protocol)."""
+    from .data.reader import batch as batch_reader
+
+    file_list: List[str] = []
+    lst = ds.test_list if test and ds.test_list else ds.train_list
+    if lst and os.path.exists(lst):
+        with open(lst) as f:
+            file_list = [ln.strip() for ln in f if ln.strip()]
+    mod = importlib.import_module(ds.module)
+    provider = getattr(mod, ds.obj)
+    reader = provider.reader(*file_list, **ds.args)
+    return batch_reader(reader, opt.batch_size), provider
+
+
+def _feeder_for(provider, model):
+    from .data.feeder import DataFeeder
+
+    # init_hook providers fill settings.input_types when reader() is built
+    types = provider.input_types or \
+        getattr(provider.settings, "input_types", None)
+    if isinstance(types, dict):
+        pairs = list(types.items())
+    else:
+        data_layers = [l for l in model.layers if l.type == "data"]
+        pairs = [(dl.name, t) for dl, t in zip(data_layers, types)]
+    return DataFeeder(pairs)
+
+
+def cmd_train(args) -> int:
+    from .config.config_parser import parse_config
+    from .layers.network import NeuralNetwork
+    from .trainer.trainer import Trainer
+
+    model, opt, ds = parse_config(args.config, args.config_args)
+    log.info("config parsed: %d layers, batch_size=%d, method=%s",
+             len(model.layers), opt.batch_size, opt.learning_method)
+    # provider modules live next to the config file
+    cfg_dir = os.path.dirname(os.path.abspath(args.config))
+    if cfg_dir not in sys.path:
+        sys.path.insert(0, cfg_dir)
+    net = NeuralNetwork(model)
+    trainer = Trainer(net, opt_config=opt)
+    # restore parameters BEFORE any job runs (test must see them)
+    if args.init_model_path:
+        trainer.load(args.init_model_path)
+    if args.save_dir:
+        FLAGS.set("save_dir", args.save_dir)
+        trainer.resume(args.save_dir)
+    reader, provider = _build_reader(ds, opt, test=(args.job == "test"))
+    feeder = _feeder_for(provider, model)
+
+    if args.job == "time":
+        metrics = trainer.time_job(reader, feeder,
+                                   batches=args.test_period or 20)
+        print(json.dumps({"job": "time", **{k: round(v, 3)
+                                            for k, v in metrics.items()}}))
+        return 0
+    if args.job == "checkgrad":
+        batch = next(iter(reader()))
+        diffs = trainer.check_gradients(feeder.convert(batch))
+        bad = {k: v for k, v in diffs.items() if v > 1e-2}
+        print(json.dumps({"job": "checkgrad", "checked": len(diffs),
+                          "failed": len(bad)}))
+        return 1 if bad else 0
+    if args.job == "test":
+        metrics = trainer.test(reader, feeder)
+        print(json.dumps({"job": "test", **metrics}))
+        return 0
+
+    trainer.train(reader, num_passes=args.num_passes, feeder=feeder)
+    if args.save_dir:
+        trainer.save(args.save_dir, args.num_passes - 1)
+    return 0
+
+
+def cmd_version(_args) -> int:
+    import jax
+
+    from . import __version__
+    print(f"paddle_tpu {__version__} (jax {jax.__version__}, "
+          f"backend {jax.default_backend()})")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="paddle",
+                                     description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    tp = sub.add_parser("train", help="train/test/time/checkgrad a config")
+    tp.add_argument("--config", required=True)
+    tp.add_argument("--job", default="train",
+                    choices=["train", "test", "time", "checkgrad"])
+    tp.add_argument("--config_args", default="")
+    tp.add_argument("--num_passes", type=int, default=1)
+    tp.add_argument("--save_dir", default="")
+    tp.add_argument("--init_model_path", default="")
+    tp.add_argument("--test_period", type=int, default=0)
+    tp.add_argument("--mesh_shape", default="",
+                    help="e.g. data=4,model=2 (replaces --trainer_count)")
+    tp.add_argument("--use_bf16", type=int, default=None)
+    tp.set_defaults(fn=cmd_train)
+
+    vp = sub.add_parser("version", help="print build info")
+    vp.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "mesh_shape", ""):
+        FLAGS.set("mesh_shape", args.mesh_shape)
+    if getattr(args, "use_bf16", None) is not None:
+        FLAGS.set("use_bf16", bool(args.use_bf16))
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -595,6 +595,39 @@ def grumemory(input: Input, name: Optional[str] = None, reverse: bool = False,
                       pas)
 
 
+def gru_step_layer(input: Input, output_mem: LayerOutput,
+                   size: Optional[int] = None, act=None, gate_act=None,
+                   name: Optional[str] = None, bias_attr=True,
+                   param_attr: Optional[ParamAttr] = None) -> LayerOutput:
+    """One GRU step for use inside recurrent groups (``GruStepLayer``);
+    inputs: 3H projection of x, previous state (a memory link)."""
+    inp = _as_list(input)[0]
+    h = size or inp.size // 3
+    # param_attr applies to the recurrent weight (input 0); the memory
+    # link (input 1) carries no parameter
+    pas = [param_attr, None] if param_attr else None
+    return _add_layer(name, "gru_step", h,
+                      _mk_inputs([inp, output_mem], pas),
+                      act or TanhActivation(), bias_attr,
+                      {"active_gate_type": _act_name(gate_act)
+                       or "sigmoid"}, None, pas)
+
+
+def lstm_step_layer(input: Input, state: LayerOutput,
+                    size: Optional[int] = None, act=None, gate_act=None,
+                    state_act=None, name: Optional[str] = None,
+                    bias_attr=True) -> LayerOutput:
+    """One LSTM step (``LstmStepLayer``); inputs: 4H projection, prev
+    cell state.  Extra output ``.state`` is the new cell."""
+    inp = _as_list(input)[0]
+    h = size or inp.size // 4
+    return _add_layer(name, "lstm_step", h, _mk_inputs([inp, state]),
+                      act or TanhActivation(), bias_attr,
+                      {"active_gate_type": _act_name(gate_act) or "sigmoid",
+                       "active_state_type": _act_name(state_act)
+                       or "tanh"})
+
+
 def recurrent(input: Input, act=None, bias_attr=True,
               param_attr: Optional[ParamAttr] = None, reverse: bool = False,
               name: Optional[str] = None) -> LayerOutput:
@@ -797,6 +830,97 @@ def eos(input: Input, eos_id: int, name: Optional[str] = None) -> LayerOutput:
 
 
 eos_layer = eos
+
+
+# --------------------------------------------------- beam-search generation
+
+
+class GeneratedInput:
+    """Marks the feedback input of a generating group: the embedding of the
+    previous step's generated token (reference ``GeneratedInput`` in
+    ``trainer_config_helpers/layers.py``; machinery
+    ``RecurrentGradientMachine.cpp:539 generateSequence``)."""
+
+    def __init__(self, size: int, embedding_name: str, embedding_size: int):
+        self.size = size                      # vocab size
+        self.embedding_name = embedding_name  # shared table param name
+        self.embedding_size = embedding_size
+
+
+class StaticInput:
+    """Read-only outer input visible at every generation step."""
+
+    def __init__(self, input: LayerOutput, is_seq: bool = False):
+        self.layer = _as_list(input)[0]
+        self.is_seq = is_seq
+
+
+def beam_search(step: Callable, input, bos_id: int, eos_id: int,
+                beam_size: int = 5, max_length: int = 100,
+                name: Optional[str] = None,
+                num_results_per_sample: Optional[int] = None) -> LayerOutput:
+    """Build a generating recurrent group decoded by beam search
+    (``beam_search`` in ``trainer_config_helpers/layers.py``; executed
+    TPU-side as a fixed-trip ``lax.scan`` with top-k expansion in
+    :mod:`paddle_tpu.layers.beam_search`)."""
+    name = name or _collector.unique_name("beam_search")
+    sub = SubModelConfig(name=name, is_generating=True)
+    ins = _as_list(input) if not isinstance(input, (list, tuple)) else \
+        list(input)
+    gen: Optional[GeneratedInput] = None
+    gen_pos = -1
+    step_args: List[Any] = []
+    static_names: List[str] = []
+    placeholder = f"__{name}_gen_id__"
+    for pos, i in enumerate(ins):
+        if isinstance(i, GeneratedInput):
+            enforce(gen is None, "beam_search allows one GeneratedInput")
+            gen, gen_pos = i, pos
+            step_args.append(None)  # filled inside the group scope
+        elif isinstance(i, StaticInput):
+            static_names.append(i.layer.name)
+            step_args.append(i.layer)
+        else:
+            static_names.append(_as_list(i)[0].name)
+            step_args.append(_as_list(i)[0])
+    enforce(gen is not None, "beam_search needs a GeneratedInput")
+
+    _collector.group_stack.append(sub)
+    try:
+        # previous generated token id (runtime-injected frame) → shared
+        # embedding inside the group, so the table parameter is created
+        # and shared with the training topology by name
+        id_ph = LayerOutput(name=placeholder, layer_type="frame",
+                            size=gen.size)
+        prev_emb = embedding(id_ph, size=gen.embedding_size,
+                             name=f"__{name}_gen_emb__",
+                             param_attr=ParamAttr(name=gen.embedding_name),
+                             vocab_size=gen.size)
+        step_args[gen_pos] = prev_emb
+        prob = _as_list(step(*step_args))[0]
+    finally:
+        _collector.group_stack.pop()
+
+    sub.out_links = [prob.name]
+    sub.generator = {
+        "bos_id": bos_id, "eos_id": eos_id, "beam_size": beam_size,
+        "max_length": max_length, "placeholder": placeholder,
+        "embedding_name": gen.embedding_name,
+        "embedding_size": gen.embedding_size,
+        "vocab_size": gen.size, "prob_layer": prob.name,
+        "num_results_per_sample": num_results_per_sample or beam_size,
+        "static_inputs": static_names,
+    }
+    _collector.sub_models.append(sub)
+    # the group's visible result: generated token sequences (+scores);
+    # a real LayerConfig so topology() pulls the group in
+    out = _add_layer(f"{name}__beam_gen__", "beam_gen", beam_size,
+                     _mk_inputs([LayerOutput(prob.name, "group_output",
+                                             prob.size)] +
+                                [LayerOutput(s, "static", 0)
+                                 for s in static_names]),
+                     None, False, {"group_name": name})
+    return out
 
 
 # ------------------------------------------------------------ glue layers

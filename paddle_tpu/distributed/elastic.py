@@ -62,14 +62,24 @@ class ElasticTrainer:
 
         self.resume()
         for epoch in range(num_epochs):
-            reader = batch_reader(
-                master_reader(self.client, self.load_fn), batch_size)
-            for samples in reader():
-                feed = feeder.convert(samples) if feeder else samples
-                loss = self.trainer.train_one_batch(feed)
-                self._maybe_checkpoint(epoch)
-                if event_handler is not None:
-                    event_handler(epoch, loss)
+            # a failing shard is marked failed (master re-queues it until
+            # failure_max) and we keep consuming — one bad shard must not
+            # kill the trainer (go/master failure-tolerance contract)
+            while True:
+                reader = batch_reader(
+                    master_reader(self.client, self.load_fn), batch_size)
+                try:
+                    for samples in reader():
+                        feed = feeder.convert(samples) if feeder \
+                            else samples
+                        loss = self.trainer.train_one_batch(feed)
+                        self._maybe_checkpoint(epoch)
+                        if event_handler is not None:
+                            event_handler(epoch, loss)
+                    break  # drained cleanly
+                except Exception as e:     # noqa: BLE001 — shard fault
+                    log.warning("shard failed (%s: %s); continuing",
+                                type(e).__name__, e)
             self._maybe_checkpoint(epoch, force=True)
             self.client.reset_epoch()
             log.info("epoch %d complete: %s", epoch, self.client.counts())

@@ -122,7 +122,12 @@ def init_parameter(key: jax.Array, spec: ParameterConfig) -> jax.Array:
     shape = tuple(spec.dims) if spec.dims else (spec.size,)
     std = spec.initial_std
     if spec.initial_smart and len(shape) >= 2:
-        std = 1.0 / np.sqrt(shape[0])
+        # fan-in = all dims but the output (last) one — for fc (in, out)
+        # that's `in` (reference semantics, Parameter.cpp initial_smart);
+        # for HWIO conv weights it's KH*KW*Cin, which 1/sqrt(shape[0])
+        # got badly wrong (1x1 convs initialized at std=1 → activations
+        # grew ~8x per layer and deep resnets overflowed at init)
+        std = 1.0 / np.sqrt(np.prod(shape[:-1]))
     if std == 0.0:
         base = jnp.zeros(shape, jnp.float32)
     elif spec.initial_strategy == 1:

@@ -1,0 +1,148 @@
+"""Beam-search generation — ``RecurrentGradientMachine::generateSequence``
+(``RecurrentGradientMachine.cpp:539``) and the SWIG ``SequenceGenerator``
+(``paddle/api/SequenceGenerator.cpp:38-96``) re-designed for XLA.
+
+The reference expands beams host-side per step with ``hl_top_k`` kernels and
+EosIdCheck layers.  Here the whole decode is ONE ``lax.scan`` with a fixed
+trip count (``max_length``): each step flattens [B, K] beams into the batch
+dim, runs the traced step sub-network once, scores candidates with
+``lax.top_k`` over K·V, gathers memories by parent beam, and freezes
+finished beams by forcing their only continuation to EOS at zero cost.
+Compiles into the same program as the encoder — no host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.model_config import ModelConfig, SubModelConfig
+from ..core.sequence import SequenceBatch, value_of
+from ..utils import ConfigError, enforce
+from .base import ForwardContext, Layer, register_layer
+from .recurrent_group import RecurrentGroup
+
+NEG_INF = -1e9
+
+
+class BeamSearchDecoder:
+    """Executes a generating SubModelConfig."""
+
+    def __init__(self, sub: SubModelConfig, model: ModelConfig):
+        enforce(sub.is_generating and sub.generator,
+                f"{sub.name} is not a generating group")
+        self.sub = sub
+        self.gen = sub.generator
+        # reuse the group step machinery (layers, memories)
+        self.group = RecurrentGroup(sub, model)
+
+    # ------------------------------------------------------------- helpers
+    def _tile_beams(self, v, k: int):
+        """[B, ...] → [B*K, ...] (repeat each row K times)."""
+        def rep(x):
+            return jnp.repeat(x, k, axis=0)
+        if isinstance(v, SequenceBatch):
+            return SequenceBatch(rep(v.data), rep(v.length))
+        if hasattr(v, "ndim") and getattr(v, "ndim", 0) >= 1:
+            return rep(v)
+        return v
+
+    # ------------------------------------------------------------ generate
+    def generate(self, params: Dict[str, jax.Array],
+                 values: Dict[str, Any], ctx: ForwardContext) -> Dict:
+        g = self.gen
+        k = int(g["beam_size"])
+        vocab = int(g["vocab_size"])
+        max_len = int(g["max_length"])
+        eos_id = int(g["eos_id"])
+        bos_id = int(g["bos_id"])
+
+        # batch size from any boot/static value
+        b = None
+        for m in self.group.memories:
+            boot = m.get("boot_layer_name")
+            if boot and boot in values:
+                b = value_of(values[boot]).shape[0]
+                break
+        if b is None:
+            for s in g.get("static_inputs", ()):
+                if s in values:
+                    b = value_of(values[s]).shape[0]
+                    break
+        enforce(b is not None, "beam search needs a boot or static input "
+                               "to infer batch size")
+
+        # beam-tiled outer context (encoder states etc.)
+        outer = {name: self._tile_beams(v, k) for name, v in values.items()}
+
+        mems0 = [self._tile_beams(
+            self.group._memory_init(m, values, b, jnp.float32), k)
+            for m in self.group.memories]
+
+        placeholder = g["placeholder"]
+        prob_name = g["prob_layer"]
+        group = self.group
+
+        batch_idx = jnp.arange(b)[:, None]                  # [B, 1]
+
+        def step_fn(carry, t):
+            last_ids, scores, alive, mems, tokens = carry
+            new_mems, step_vals = group.step(
+                params, {placeholder: last_ids.reshape(-1)}, mems, outer,
+                ctx)
+            probs = value_of(step_vals[prob_name])          # [B*K, V]
+            logp = jnp.log(jnp.maximum(probs, 1e-20))
+            logp = logp.reshape(b, k, vocab)
+            # finished beams may only continue with EOS at zero cost
+            eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
+            logp = jnp.where(alive[:, :, None], logp, eos_only)
+            cand = scores[:, :, None] + logp                # [B, K, V]
+            top_scores, top_idx = jax.lax.top_k(
+                cand.reshape(b, k * vocab), k)              # [B, K]
+            parent = top_idx // vocab
+            token = top_idx % vocab
+
+            # gather state by parent beam
+            def regather(x):
+                shaped = x.reshape((b, k) + x.shape[1:])
+                return shaped[batch_idx, parent].reshape(
+                    (b * k,) + x.shape[1:])
+            mems_g = [jax.tree_util.tree_map(regather, m_)
+                      for m_ in new_mems]
+            tokens_g = tokens[batch_idx, parent]            # [B, K, T]
+            tokens_g = tokens_g.at[:, :, t].set(token)
+            alive_g = alive[batch_idx, parent] & (token != eos_id)
+            return (token, top_scores, alive_g, mems_g, tokens_g), None
+
+        tokens0 = jnp.zeros((b, k, max_len), jnp.int32)
+        # beam 0 starts live, others at -inf so step 1 yields K distinct
+        scores0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (k - 1),
+                                       jnp.float32), (b, 1))
+        carry0 = (jnp.full((b, k), bos_id, jnp.int32), scores0,
+                  jnp.ones((b, k), bool), mems0, tokens0)
+        (last, scores, alive, _, tokens), _ = jax.lax.scan(
+            step_fn, carry0, jnp.arange(max_len))
+
+        # sequence length = position of first EOS (inclusive) else max_len
+        is_eos = tokens == eos_id                            # [B, K, T]
+        any_eos = jnp.any(is_eos, axis=-1)
+        first_eos = jnp.argmax(is_eos, axis=-1)
+        lengths = jnp.where(any_eos, first_eos + 1, max_len).astype(jnp.int32)
+        return {"ids": tokens, "lengths": lengths, "scores": scores,
+                "beam_size": k}
+
+
+@register_layer("beam_gen")
+class BeamGenLayer(Layer):
+    """Root-visible handle of a generating group: its first input is the
+    bundle the decoder wrote; exposes ids (as a nested SequenceBatch
+    [B, K, T]) plus ``.scores`` / ``.lengths`` extra outputs."""
+
+    def forward(self, params, inputs, ctx):
+        bundle = inputs[0]
+        enforce(isinstance(bundle, dict) and "ids" in bundle,
+                "beam_gen input must be the generation bundle")
+        return {"out": bundle["ids"], "scores": bundle["scores"],
+                "lengths": bundle["lengths"]}

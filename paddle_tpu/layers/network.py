@@ -26,6 +26,7 @@ from ..core.sequence import SequenceBatch, value_of
 from ..utils import ConfigError, enforce, global_stat, layer_stack
 from .base import LAYERS, ForwardContext, Layer, init_parameter
 from . import common, conv, cost, rnn, seq  # noqa: F401  (register layers)
+from . import beam_search  # noqa: F401  (registers beam_gen)
 
 
 class NeuralNetwork:
@@ -54,6 +55,7 @@ class NeuralNetwork:
             sm.name: sm for sm in config.sub_models
             if sm.name != "root" and sm.is_generating
         }
+        self._decoders: Dict[str, Any] = {}
 
         for lconf in config.layers:
             if lconf.name in sub_layer_names and lconf.type != "data":
@@ -68,6 +70,11 @@ class NeuralNetwork:
         self._collect_specs(self.layers.values(), declared)
         for g in self.groups.values():
             self._collect_specs(g.layers.values(), declared)
+        for sm in self.gen_groups.values():
+            from .beam_search import BeamSearchDecoder
+            dec = BeamSearchDecoder(sm, config)
+            self._decoders[sm.name] = dec
+            self._collect_specs(dec.group.layers.values(), declared)
         self.static_params: Set[str] = {
             n for n, s in self.param_specs.items() if s.is_static}
 
@@ -163,9 +170,19 @@ class NeuralNetwork:
             raise ConfigError(f"layer input {name!r} has no producer")
         group = self.groups.get(group_name)
         if group is None:
-            raise ConfigError(
-                f"generating group {group_name!r} must run via generate()")
-        group.run(params, values, ctx)
+            sm = self.gen_groups.get(group_name)
+            if sm is None:
+                raise ConfigError(f"no producer for group {group_name!r}")
+            dec = self._decoders.get(group_name)
+            if dec is None:   # decoders are prebuilt in __init__
+                from .beam_search import BeamSearchDecoder
+                dec = self._decoders[group_name] = \
+                    BeamSearchDecoder(sm, self.config)
+            bundle = dec.generate(params, values, ctx)
+            for link in sm.out_links:
+                values[link] = bundle
+        else:
+            group.run(params, values, ctx)
         done_groups.add(group_name)
 
     # --------------------------------------------------------------- loss

@@ -1,0 +1,189 @@
+"""Image model builders — the reference's benchmark + model-zoo networks.
+
+Workloads from ``benchmark/paddle/image/{alexnet,googlenet,vgg,
+smallnet_mnist_cifar}.py`` and ResNet from ``v1_api_demo/model_zoo/resnet``
+/ ``test_image_classification_train.py`` (resnet_cifar10), rebuilt on the
+TPU-native DSL.  All return a ``(prob_layer, cost_layer)`` pair given the
+data/label layers so callers choose training or inference topologies.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..config import dsl
+from ..config.dsl import (AvgPooling, ExtraAttr, LinearActivation,
+                          MaxPooling, ReluActivation, SoftmaxActivation)
+
+
+def _conv(net, fs, nf, stride=1, pad=None, channels=None, act=None,
+          groups=1):
+    return dsl.img_conv(net, filter_size=fs, num_filters=nf, stride=stride,
+                        padding=fs // 2 if pad is None else pad,
+                        num_channels=channels, groups=groups,
+                        act=act or ReluActivation())
+
+
+def _pool(net, size=3, stride=2, pad=0, avg=False):
+    return dsl.img_pool(net, pool_size=size, stride=stride, padding=pad,
+                        pool_type=AvgPooling() if avg else MaxPooling())
+
+
+# ------------------------------------------------------------------ nets
+def smallnet_mnist_cifar(img, num_classes: int = 10):
+    """``smallnet_mnist_cifar.py`` (CIFAR quick): 3 conv + 2 fc."""
+    net = _conv(img, 5, 32, 1, 2, channels=3)
+    net = _pool(net, 3, 2, 1)
+    net = _conv(net, 5, 32, 1, 2)
+    net = _pool(net, 3, 2, 1, avg=True)
+    net = _conv(net, 3, 64, 1, 1)
+    net = _pool(net, 3, 2, 1, avg=True)
+    net = dsl.fc(net, size=64, act=ReluActivation())
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())
+
+
+def alexnet(img, num_classes: int = 1000):
+    """``alexnet.py``: 5 conv (+LRN on 1-2) + 3 fc w/ dropout."""
+    net = _conv(img, 11, 96, 4, 1, channels=3)
+    net = dsl.img_cmrnorm(net, size=5, scale=0.0001, power=0.75)
+    net = _pool(net)
+    net = _conv(net, 5, 256, 1, 2)
+    net = dsl.img_cmrnorm(net, size=5, scale=0.0001, power=0.75)
+    net = _pool(net)
+    net = _conv(net, 3, 384, 1, 1)
+    net = _conv(net, 3, 384, 1, 1)
+    net = _conv(net, 3, 256, 1, 1)
+    net = _pool(net)
+    net = dsl.fc(net, size=4096, act=ReluActivation(),
+                 layer_attr=ExtraAttr(drop_rate=0.5))
+    net = dsl.fc(net, size=4096, act=ReluActivation(),
+                 layer_attr=ExtraAttr(drop_rate=0.5))
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())
+
+
+def vgg(img, depth: int = 19, num_classes: int = 1000):
+    """``vgg.py``: VGG-16/19 as conv groups + 2×4096 fc."""
+    assert depth in (16, 19)
+    reps = 3 if depth == 16 else 4
+    from ..v2.networks import img_conv_group
+
+    net = img_conv_group(img, conv_num_filter=[64, 64],
+                         conv_filter_size=3, conv_act=ReluActivation(),
+                         pool_size=2, pool_stride=2, num_channels=3)
+    net = img_conv_group(net, conv_num_filter=[128, 128],
+                         conv_filter_size=3, conv_act=ReluActivation(),
+                         pool_size=2, pool_stride=2)
+    for nf in (256, 512, 512):
+        net = img_conv_group(net, conv_num_filter=[nf] * reps,
+                             conv_filter_size=3,
+                             conv_act=ReluActivation(), pool_size=2,
+                             pool_stride=2)
+    net = dsl.fc(net, size=4096, act=ReluActivation(),
+                 layer_attr=ExtraAttr(drop_rate=0.5))
+    net = dsl.fc(net, size=4096, act=ReluActivation(),
+                 layer_attr=ExtraAttr(drop_rate=0.5))
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())
+
+
+def _inception(name, input, channels, f1, f3r, f3, f5r, f5, proj):
+    """GoogleNet inception module (``googlenet.py`` inception2)."""
+    cov1 = _conv(input, 1, f1, 1, 0, channels=channels)
+    cov3r = _conv(input, 1, f3r, 1, 0, channels=channels)
+    cov3 = _conv(cov3r, 3, f3, 1, 1)
+    cov5r = _conv(input, 1, f5r, 1, 0, channels=channels)
+    cov5 = _conv(cov5r, 5, f5, 1, 2)
+    pool = _pool(input, 3, 1, 1)
+    covprj = _conv(pool, 1, proj, 1, 0)
+    out = dsl.concat([cov1, cov3, cov5, covprj], name=f"{name}_concat")
+    out.channels = f1 + f3 + f5 + proj
+    out.img_size = cov1.img_size
+    out.img_size_y = cov1.img_size_y
+    out.size = out.channels * out.img_size * out.img_size_y
+    return out
+
+
+def googlenet(img, num_classes: int = 1000):
+    """``googlenet.py``: stem + 9 inception modules + avg pool."""
+    net = _conv(img, 7, 64, 2, 3, channels=3)
+    net = _pool(net, 3, 2, 1)
+    net = _conv(net, 1, 64, 1, 0)
+    net = _conv(net, 3, 192, 1, 1)
+    net = _pool(net, 3, 2, 1)
+    net = _inception("i3a", net, 192, 64, 96, 128, 16, 32, 32)
+    net = _inception("i3b", net, 256, 128, 128, 192, 32, 96, 64)
+    net = _pool(net, 3, 2, 1)
+    net = _inception("i4a", net, 480, 192, 96, 208, 16, 48, 64)
+    net = _inception("i4b", net, 512, 160, 112, 224, 24, 64, 64)
+    net = _inception("i4c", net, 512, 128, 128, 256, 24, 64, 64)
+    net = _inception("i4d", net, 512, 112, 144, 288, 32, 64, 64)
+    net = _inception("i4e", net, 528, 256, 160, 320, 32, 128, 128)
+    net = _pool(net, 3, 2, 1)
+    net = _inception("i5a", net, 832, 256, 160, 320, 32, 128, 128)
+    net = _inception("i5b", net, 832, 384, 192, 384, 48, 128, 128)
+    net = _pool(net, 7, 1, 0, avg=True)
+    net = dsl.dropout(net, dropout_rate=0.4)
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())
+
+
+def _bn_conv(net, fs, nf, stride=1, pad=None, channels=None,
+             act=None, linear=False):
+    c = _conv(net, fs, nf, stride, pad, channels=channels,
+              act=LinearActivation())
+    return dsl.batch_norm(c, act=LinearActivation() if linear
+                          else (act or ReluActivation()))
+
+
+def _shortcut(net, out_ch, stride):
+    if getattr(net, "channels", None) != out_ch or stride != 1:
+        return _bn_conv(net, 1, out_ch, stride, 0, linear=True)
+    return net
+
+
+def _residual(short, main):
+    out = dsl.addto([short, main], act=ReluActivation())
+    out.channels = main.channels
+    out.img_size = main.img_size
+    out.img_size_y = main.img_size_y
+    return out
+
+
+def _basic_block(net, ch, stride):
+    short = _shortcut(net, ch, stride)
+    c1 = _bn_conv(net, 3, ch, stride, 1)
+    c2 = _bn_conv(c1, 3, ch, 1, 1, linear=True)
+    return _residual(short, c2)
+
+
+def _bottleneck(net, ch, stride):
+    short = _shortcut(net, ch * 4, stride)
+    c1 = _bn_conv(net, 1, ch, stride, 0)
+    c2 = _bn_conv(c1, 3, ch, 1, 1)
+    c3 = _bn_conv(c2, 1, ch * 4, 1, 0, linear=True)
+    return _residual(short, c3)
+
+
+def resnet_cifar10(img, depth: int = 32, num_classes: int = 10):
+    """``test_image_classification_train.py:13`` resnet_cifar10:
+    6n+2 layers of basic blocks over 16/32/64 channels."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    net = _bn_conv(img, 3, 16, 1, 1, channels=3)
+    for ch, first_stride in ((16, 1), (32, 2), (64, 2)):
+        for i in range(n):
+            net = _basic_block(net, ch, first_stride if i == 0 else 1)
+    net = _pool(net, 8, 1, 0, avg=True)
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())
+
+
+def resnet(img, depth: int = 50, num_classes: int = 1000):
+    """``model_zoo/resnet``: ImageNet ResNet-50/101/152 (bottlenecks)."""
+    cfg = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}[depth]
+    net = _bn_conv(img, 7, 64, 2, 3, channels=3)
+    net = _pool(net, 3, 2, 1)
+    for stage, blocks in enumerate(cfg):
+        ch = 64 * (2 ** stage)
+        for i in range(blocks):
+            stride = 2 if stage > 0 and i == 0 else 1
+            net = _bottleneck(net, ch, stride)
+    net = _pool(net, 7, 1, 0, avg=True)
+    return dsl.fc(net, size=num_classes, act=SoftmaxActivation())

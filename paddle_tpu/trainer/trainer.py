@@ -298,15 +298,17 @@ class Trainer:
                 break
             feeds.append(feeder.convert(batch) if feeder else batch)
         enforce(len(feeds) > warmup, "not enough batches to time")
+        # float() forces a D2H sync; block_until_ready alone does not
+        # reliably drain remote (tunneled) backends
         for f in feeds[:warmup]:
             loss = self.train_one_batch(f)
-        jax.block_until_ready(loss)
+        float(loss)
         t0 = time.perf_counter()
         samples = 0
         for f in feeds[warmup:]:
             loss = self.train_one_batch(f)
             samples += _batch_size(f)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         timed = len(feeds) - warmup
         return {

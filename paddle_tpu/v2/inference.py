@@ -24,7 +24,7 @@ class Inference:
                 if name in self.params:
                     self.params[name] = jnp.asarray(parameters.get(name))
 
-    def iter_infer(self, input, feeding=None):
+    def iter_infer(self, input, feeding=None, field=None):
         from .trainer import SGD
 
         feeder = SGD._feeder(self, feeding) if feeding else None
@@ -33,11 +33,29 @@ class Inference:
             values, _ = self.network.forward(
                 self.params, feed, self.buffers, is_training=False)
             outs = self.network.outputs(values)
-            yield [np.asarray(value_of(v)) for v in outs.values()]
+            if field is None:
+                yield [np.asarray(value_of(v)) for v in outs.values()]
+                continue
+            # generation fields (SWIG SequenceGenerator parity):
+            # "id" → generated token ids, "prob"/"score" → beam scores,
+            # "len" → sequence lengths, "value" → the raw output value
+            row = []
+            for name in outs:
+                for f in (field if isinstance(field, (list, tuple))
+                          else [field]):
+                    if f in ("prob", "score"):
+                        row.append(np.asarray(
+                            value_of(values[f"{name}.scores"])))
+                    elif f == "len":
+                        row.append(np.asarray(
+                            value_of(values[f"{name}.lengths"])))
+                    else:   # "id" / "value"
+                        row.append(np.asarray(value_of(values[name])))
+            yield row
 
-    def infer(self, input, feeding=None):
+    def infer(self, input, feeding=None, field=None):
         results = []
-        for out in self.iter_infer(input, feeding):
+        for out in self.iter_infer(input, feeding, field=field):
             results.append(out[0] if len(out) == 1 else out)
         if len(results) == 1:
             return results[0]
@@ -51,5 +69,7 @@ class Inference:
         return np.concatenate(results) if results[0].ndim > 0 else results
 
 
-def infer(output_layer, parameters=None, input=None, feeding=None):
-    return Inference(output_layer, parameters).infer(input, feeding)
+def infer(output_layer, parameters=None, input=None, feeding=None,
+          field=None):
+    return Inference(output_layer, parameters).infer(input, feeding,
+                                                     field=field)

@@ -1,0 +1,78 @@
+"""Model-zoo and CLI tests (reference: benchmark configs must run via
+``paddle train --job=time``; model zoo topologies build and forward)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.data.feeder import dense_vector
+from paddle_tpu.layers.network import NeuralNetwork
+from paddle_tpu.models import image as M
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _forward(builder, side, nclass=10, batch=2):
+    with config_scope():
+        img = dsl.data("image", dense_vector(side * side * 3),
+                       height=side, width=side)
+        prob = builder(img, nclass)
+        cfg = dsl.topology(prob)
+    net = NeuralNetwork(cfg)
+    params = net.init_params(seed=0)
+    x = jax.numpy.asarray(
+        np.random.RandomState(0).randn(batch, side * side * 3),
+        jax.numpy.float32)
+    vals, _ = net.forward(params, {"image": x}, net.init_buffers(),
+                          is_training=False)
+    return np.asarray(vals[prob.name])
+
+
+def test_smallnet_forward():
+    out = _forward(M.smallnet_mnist_cifar, 32)
+    assert out.shape == (2, 10) and np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_resnet_cifar10_forward():
+    out = _forward(lambda i, n: M.resnet_cifar10(i, 20, n), 32)
+    assert out.shape == (2, 10) and np.isfinite(out).all()
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_deep_net_finite_at_init():
+    """Activation magnitudes must not explode through 50 layers (guards
+    the smart-init fan-in fix for conv weights)."""
+    out = _forward(lambda i, n: M.resnet_cifar10(i, 56, n), 32, batch=1)
+    assert np.isfinite(out).all()
+
+
+def test_cli_time_job():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "train",
+         "--config", os.path.join(REPO, "benchmark", "image.py"),
+         "--job", "time",
+         "--config_args", "model=smallnet,batch_size=16,num_samples=160"],
+        capture_output=True, text=True, timeout=500, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["job"] == "time" and out["samples_per_sec"] > 0
+
+
+def test_cli_version():
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu", "version"],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0 and "paddle_tpu" in r.stdout
