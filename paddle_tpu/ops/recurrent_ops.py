@@ -99,7 +99,7 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
                          c=m * new_state.c + (1 - m) * state.c)
         return keep, m * h
 
-    final, hs = lax.scan(step, init, (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)))
+    final, hs = lax.scan(step, init, (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)), unroll=2)
     hs = jnp.moveaxis(hs, 0, 1)
     if reverse:
         hs = hs[:, ::-1]
@@ -147,7 +147,7 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
         h_keep = m * h_new + (1 - m) * h
         return h_keep, m * h_new
 
-    final, hs = lax.scan(step, init, (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)))
+    final, hs = lax.scan(step, init, (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)), unroll=2)
     hs = jnp.moveaxis(hs, 0, 1)
     if reverse:
         hs = hs[:, ::-1]
@@ -178,7 +178,7 @@ def simple_rnn(seq: SequenceBatch, w_hh, bias=None, h0=None,
         h_keep = m * h_new + (1 - m) * h
         return h_keep, m * h_new
 
-    final, hs = lax.scan(step, init, (jnp.moveaxis(x, 1, 0), jnp.moveaxis(mask, 1, 0)))
+    final, hs = lax.scan(step, init, (jnp.moveaxis(x, 1, 0), jnp.moveaxis(mask, 1, 0)), unroll=2)
     hs = jnp.moveaxis(hs, 0, 1)
     if reverse:
         hs = hs[:, ::-1]

@@ -17,3 +17,5 @@ annotations (SURVEY §2.5 mapping):
 
 from .sharding import (ShardingRules, tp_rules, shard_params,
                        constraint)  # noqa: F401
+from .ring_attention import (ring_attention, ulysses_attention,
+                             full_attention)  # noqa: F401

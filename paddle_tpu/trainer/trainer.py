@@ -243,6 +243,12 @@ class Trainer:
                     event_handler(ev.EndIteration(
                         pass_id=pass_id, batch_id=batch_id,
                         cost=float(loss)))
+                if FLAGS.show_parameter_stats_period and \
+                        (batch_id + 1) % \
+                        FLAGS.show_parameter_stats_period == 0:
+                    from ..utils.profiler import parameter_stats
+                    log.info("parameter stats:\n%s",
+                             parameter_stats(self.params))
                 batch_id += 1
             metrics = {}
             if test_reader is not None:

@@ -1,0 +1,65 @@
+"""Profiling & tracing (SURVEY §5 aux subsystems).
+
+The reference aggregates RAII wall timers per named section
+(``REGISTER_TIMER``/``StatSet``, ``paddle/utils/Stat.h:63-242``) and opens
+nvprof windows via ``hl_profiler_start/end``
+(``hl_cuda_device.cc:675-677``).  TPU equivalents:
+
+- named wall timers: :mod:`paddle_tpu.utils.stat` (already per-section);
+- device traces: :func:`trace` wraps ``jax.profiler`` so a window of
+  steps lands in an xprof/TensorBoard trace directory;
+- FP-fault trapping (``feenableexcept`` in ``TrainerMain.cpp:49``):
+  :func:`enable_fp_exceptions` flips ``jax_debug_nans``/``jax_debug_infs``
+  so the first NaN/Inf inside a jitted computation raises at the op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+from .logger import get_logger
+
+log = get_logger("profiler")
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/paddle_tpu_trace") -> Iterator[None]:
+    """``with profiler.trace(dir): ...`` — xprof window (nvprof-window
+    equivalent); view with TensorBoard's profile plugin."""
+    jax.profiler.start_trace(logdir)
+    log.info("profiler trace started → %s", logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", logdir)
+
+
+def annotate(name: str):
+    """Named sub-trace region (``REGISTER_TIMER_INFO`` equivalent inside
+    traced code)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def enable_fp_exceptions(enable: bool = True) -> None:
+    """Trap NaN/Inf produced by jitted computations — the
+    ``feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)`` equivalent."""
+    jax.config.update("jax_debug_nans", enable)
+    jax.config.update("jax_debug_infs", enable)
+
+
+def parameter_stats(params) -> str:
+    """Per-parameter |value| stats line (``--show_parameter_stats_period``,
+    ``TrainerInternal.cpp:99-111``)."""
+    import numpy as np
+
+    rows = []
+    for name in sorted(params):
+        v = np.asarray(jax.device_get(params[name]))
+        rows.append(f"{name}: shape={tuple(v.shape)} "
+                    f"absmax={np.abs(v).max():.4g} "
+                    f"mean={v.mean():.4g} std={v.std():.4g}")
+    return "\n".join(rows)
