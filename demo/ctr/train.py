@@ -33,8 +33,15 @@ def main():
             "ids", paddle.data_type.integer_value_sequence(SPARSE_DIM))
         label = paddle.layer.data("label",
                                   paddle.data_type.integer_value(2))
-        # deep: embed each slot, pool
-        emb = paddle.layer.embedding(ids, size=16, name="slot_emb")
+        # deep: embed each slot, pool; sparse_update=True → lazy
+        # row-sparse optimizer updates, only the batch's rows get
+        # value/moment writes (SparseRemoteParameterUpdater contract,
+        # paddle_tpu/parallel/sparse.py)
+        emb = paddle.layer.embedding(
+            ids, size=16, name="slot_emb",
+            param_attr=dsl.ParamAttr(name="_slot_emb.w",
+                                     sparse_update=True,
+                                     initial_std=0.02))
         deep_in = dsl.pooling(emb, pooling_type=dsl.SumPooling())
         deep = paddle.layer.fc(deep_in, size=32,
                                act=paddle.activation.Relu())

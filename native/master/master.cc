@@ -35,6 +35,50 @@ namespace {
 
 using Clock = std::chrono::steady_clock;
 
+// Payloads are arbitrary strings but both the snapshot file and the TCP
+// protocol are line/tab-framed, so control bytes are %-escaped on the way
+// in and decoded on the way out (mirrored by MasterClient in
+// paddle_tpu/distributed/master.py).
+std::string EscapePayload(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    if (c == '%' || c == '\n' || c == '\r' || c == '\t' || c == '\x1f') {
+      char buf[4];
+      std::snprintf(buf, sizeof(buf), "%%%02X", c);
+      out += buf;
+    } else {
+      out += static_cast<char>(c);
+    }
+  }
+  return out;
+}
+
+int HexVal(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  return -1;
+}
+
+std::string UnescapePayload(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); ++i) {
+    // decode only well-formed %XX; a literal '%' from a pre-escaping
+    // writer (legacy snapshot / old master) passes through untouched
+    int hi, lo;
+    if (s[i] == '%' && i + 2 < s.size() && (hi = HexVal(s[i + 1])) >= 0 &&
+        (lo = HexVal(s[i + 2])) >= 0) {
+      out += static_cast<char>(hi * 16 + lo);
+      i += 2;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
 struct Task {
   int id = 0;
   std::string payload;
@@ -156,8 +200,8 @@ class MasterService {
     if (snapshot_path_.empty()) return;
     std::ostringstream os;
     auto dump = [&os](const char* tag, const Task& t) {
-      os << tag << "\t" << t.id << "\t" << t.failures << "\t" << t.payload
-         << "\n";
+      os << tag << "\t" << t.id << "\t" << t.failures << "\t"
+         << EscapePayload(t.payload) << "\n";
     };
     for (const auto& t : todo_) dump("todo", t);
     for (const auto& kv : pending_) dump("todo", kv.second);  // re-lease
@@ -207,11 +251,10 @@ class MasterService {
       if (!(is >> tag >> id >> failures)) continue;
       std::getline(is, payload);
       if (!payload.empty() && payload[0] == '\t') payload.erase(0, 1);
-      while (!payload.empty() && payload[0] == ' ') payload.erase(0, 1);
       Task t;
       t.id = id;
       t.failures = failures;
-      t.payload = payload;
+      t.payload = UnescapePayload(payload);
       if (tag == "todo") {
         todo_.push_back(std::move(t));
       } else if (tag == "done") {
@@ -277,7 +320,7 @@ std::string MasterService::HandleLineImpl(const std::string& line) {
     int id;
     int rc = GetTask(&payload, &id);
     if (rc == 0)
-      return "OK\t" + std::to_string(id) + "\t" + payload;
+      return "OK\t" + std::to_string(id) + "\t" + EscapePayload(payload);
     return rc == 1 ? "WAIT" : "DONE";
   }
   if (cmd == "FIN" || cmd == "FAIL") {
@@ -293,7 +336,7 @@ std::string MasterService::HandleLineImpl(const std::string& line) {
     std::vector<std::string> payloads;
     std::istringstream ps(rest);
     std::string p;
-    while (std::getline(ps, p, '\x1f')) payloads.push_back(p);
+    while (std::getline(ps, p, '\x1f')) payloads.push_back(UnescapePayload(p));
     SetDataset(payloads);
     return "OK";
   }

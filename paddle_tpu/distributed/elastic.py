@@ -13,11 +13,10 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from ..trainer.trainer import Trainer
 from ..utils import get_logger
-from .master import Master, MasterClient, master_reader
 
 log = get_logger("elastic")
 
@@ -56,30 +55,87 @@ class ElasticTrainer:
             self.trainer.save(self.save_dir, epoch)
             self._last_ckpt = now
 
+    def _train_batch(self, feeder, samples, epoch: int,
+                     event_handler: Optional[Callable]) -> None:
+        feed = feeder.convert(samples) if feeder else samples
+        loss = self.trainer.train_one_batch(feed)
+        self._maybe_checkpoint(epoch)
+        if event_handler is not None:
+            event_handler(epoch, loss)
+
     def train(self, feeder, batch_size: int, num_epochs: int = 1,
               event_handler: Optional[Callable] = None) -> None:
-        from ..data.reader import batch as batch_reader
-
         self.resume()
         for epoch in range(num_epochs):
-            # a failing shard is marked failed (master re-queues it until
-            # failure_max) and we keep consuming — one bad shard must not
-            # kill the trainer (go/master failure-tolerance contract)
-            while True:
-                reader = batch_reader(
-                    master_reader(self.client, self.load_fn), batch_size)
-                try:
-                    for samples in reader():
-                        feed = feeder.convert(samples) if feeder \
-                            else samples
-                        loss = self.trainer.train_one_batch(feed)
-                        self._maybe_checkpoint(epoch)
-                        if event_handler is not None:
-                            event_handler(epoch, loss)
-                    break  # drained cleanly
-                except Exception as e:     # noqa: BLE001 — shard fault
-                    log.warning("shard failed (%s: %s); continuing",
-                                type(e).__name__, e)
+            self._train_one_epoch(feeder, batch_size, epoch, event_handler)
             self._maybe_checkpoint(epoch, force=True)
             self.client.reset_epoch()
             log.info("epoch %d complete: %s", epoch, self.client.counts())
+
+    def _train_one_epoch(self, feeder, batch_size: int, epoch: int,
+                         event_handler: Optional[Callable]) -> None:
+        """Lease tasks and train them, marking a task FINished only once
+        every one of its samples has actually gone through a training
+        step.  Any exception — in ``load_fn`` *or* on the consumer side
+        (feeder/``train_one_batch``) — FAILs the leased tasks whose
+        samples were in flight, so the master re-queues them instead of
+        waiting out the lease; one bad shard must not kill the trainer
+        (``go/master/service.go:313`` failure-tolerance contract).
+        Samples buffered from earlier tasks are tracked per-task (at-least
+        -once: a task is re-leased unless fully trained)."""
+        buf: List[tuple] = []          # (task_id, sample) carried remainder
+        open_tasks: List[int] = []     # leased ids not yet FIN/FAILed
+
+        def _finish_drained() -> None:
+            # a leased task is complete once it's fully loaded (always
+            # true here — tasks enter open_tasks after their load loop
+            # ends) and none of its samples await training; covers
+            # zero-sample shards too
+            remaining = {t for t, _ in buf}
+            for t in list(open_tasks):
+                if t not in remaining:
+                    open_tasks.remove(t)
+                    self.client.task_finished(t)
+
+        def _train_buffered(flush_tail: bool) -> None:
+            while len(buf) >= batch_size:
+                chunk, rest = buf[:batch_size], buf[batch_size:]
+                self._train_batch(feeder, [s for _, s in chunk],
+                                  epoch, event_handler)
+                buf[:] = rest
+                _finish_drained()
+            if flush_tail and buf:
+                self._train_batch(feeder, [s for _, s in buf],
+                                  epoch, event_handler)
+                buf.clear()
+            _finish_drained()
+
+        def _fail_in_flight(e: Exception, what: str) -> None:
+            for t in open_tasks:        # in-flight tasks → re-queue now
+                self.client.task_failed(t)
+            open_tasks.clear()
+            buf.clear()
+            log.warning("%s failed (%s: %s); continuing", what,
+                        type(e).__name__, e)
+
+        while True:
+            tid, payload = self.client.get_task()
+            if payload is None:
+                # WAIT means every remaining task is leased elsewhere —
+                # or by US (sub-batch remainders); flush so our own
+                # leases can finish, else the epoch deadlocks on them
+                try:
+                    _train_buffered(flush_tail=True)
+                except Exception as e:  # noqa: BLE001 — shard fault
+                    _fail_in_flight(e, "tail batch")
+                if tid == 1:
+                    time.sleep(0.05)
+                    continue
+                break                   # epoch drained
+            open_tasks.append(tid)
+            try:
+                for sample in self.load_fn(payload):
+                    buf.append((tid, sample))
+                _train_buffered(flush_tail=False)
+            except Exception as e:      # noqa: BLE001 — shard fault
+                _fail_in_flight(e, "shard")

@@ -125,6 +125,37 @@ class Master:
             pass
 
 
+def _escape_payload(s: str) -> str:
+    """%-escape control bytes that would break the line/tab framing
+    (mirrors ``EscapePayload`` in ``native/master/master.cc``)."""
+    out = []
+    for ch in s:
+        if ch in "%\n\r\t\x1f":
+            out.append("%%%02X" % ord(ch))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+_HEX = set("0123456789abcdefABCDEF")
+
+
+def _unescape_payload(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        # decode only well-formed %XX; a literal '%' from a pre-escaping
+        # master passes through untouched
+        if s[i] == "%" and i + 3 <= len(s) and s[i + 1] in _HEX \
+                and s[i + 2] in _HEX:
+            out.append(chr(int(s[i + 1:i + 3], 16)))
+            i += 3
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
 class MasterClient:
     """TCP client speaking the master's line protocol (remote trainers)."""
 
@@ -144,13 +175,14 @@ class MasterClient:
         return resp.decode()
 
     def set_dataset(self, payloads: Sequence[str]) -> None:
-        self._call("SET\t" + "\x1f".join(payloads))
+        self._call("SET\t" + "\x1f".join(_escape_payload(p)
+                                         for p in payloads))
 
     def get_task(self) -> Tuple[int, Optional[str]]:
         resp = self._call("GET")
         if resp.startswith("OK\t"):
             _, tid, payload = resp.split("\t", 2)
-            return int(tid), payload
+            return int(tid), _unescape_payload(payload)
         return (1, None) if resp == "WAIT" else (-1, None)
 
     def task_finished(self, task_id: int) -> None:

@@ -114,8 +114,9 @@ def _mul(ctx, ins, attrs):
     x2 = x.reshape((int(_np.prod(xs[:xd])), -1)) if x.ndim > 2 else x
     y2 = y.reshape((int(_np.prod(ys[:yd])), -1)) if y.ndim > 2 else y
     out = x2 @ y2
-    if x.ndim > 2:
-        out = out.reshape(xs[:xd] + (y2.shape[1],))
+    if x.ndim > 2 or y.ndim > 2:
+        # reference mul_op output shape: xs[:x_num_col_dims] + ys[y_num_col_dims:]
+        out = out.reshape(xs[:xd] + ys[yd:])
     return {"Out": [out]}
 
 

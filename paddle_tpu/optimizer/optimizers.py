@@ -34,6 +34,18 @@ def tree_map(fn, *trees):
     return jax.tree_util.tree_map(fn, *trees)
 
 
+def _mask_rows(mask, p_old, p_new, slot_old, slot_new):
+    """Keep updated values only on touched rows; revert the rest (value
+    and any param-shaped slot leaf — scalar/step slots pass through)."""
+    m = mask.reshape((-1,) + (1,) * (p_old.ndim - 1))
+    p = jnp.where(m, p_new, p_old)
+    slot = tuple(
+        jnp.where(m, sn, so) if getattr(so, "shape", None) == p_old.shape
+        else sn
+        for so, sn in zip(slot_old, slot_new))
+    return p, slot
+
+
 @dataclasses.dataclass
 class Optimizer:
     """Base class; subclasses define per-leaf slot init and update math."""
@@ -58,7 +70,8 @@ class Optimizer:
 
     def apply(self, params: PyTree, grads: PyTree, state: PyTree,
               lr: Optional[jax.Array] = None,
-              lr_scales: Optional[PyTree] = None
+              lr_scales: Optional[PyTree] = None,
+              sparse_masks: Optional[PyTree] = None
               ) -> Tuple[PyTree, PyTree]:
         lr = jnp.asarray(self.learning_rate if lr is None else lr, jnp.float32)
         count, slots = state
@@ -69,6 +82,10 @@ class Optimizer:
             scale_leaves = [None] * len(p_leaves)
         else:
             scale_leaves = treedef.flatten_up_to(lr_scales)
+        if sparse_masks is None:
+            mask_leaves = [None] * len(p_leaves)
+        else:
+            mask_leaves = treedef.flatten_up_to(sparse_masks)
         if self.gradient_clipping_threshold > 0:
             # reference clips per-parameter elementwise by threshold
             t = self.gradient_clipping_threshold
@@ -77,15 +94,63 @@ class Optimizer:
             g_leaves = [g + self.weight_decay * p
                         for g, p in zip(g_leaves, p_leaves)]
         new_p, new_slots = [], []
-        for p, g, slot, sc in zip(p_leaves, g_leaves, slots, scale_leaves):
+        for p, g, slot, sc, mask in zip(p_leaves, g_leaves, slots,
+                                        scale_leaves, mask_leaves):
             eff_lr = lr if sc is None else lr * sc
             np_, ns = self._update(p, g, slot, eff_lr, count)
             if self.l1_decay:
                 shrink = eff_lr * self.l1_decay
                 np_ = jnp.sign(np_) * jnp.maximum(jnp.abs(np_) - shrink, 0.0)
+            if mask is not None:
+                # lazy row-sparse semantics (SparseRowMatrix contract):
+                # untouched rows keep value AND slots bit-identical
+                np_, ns = _mask_rows(mask, p, np_, slot, ns)
             new_p.append(np_)
             new_slots.append(ns)
         return treedef.unflatten(new_p), (count, new_slots)
+
+    def apply_rows(self, table: jax.Array, rows: jax.Array,
+                   row_grads: jax.Array, state: Tuple[jax.Array, tuple],
+                   lr: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, Tuple[jax.Array, tuple]]:
+        """Fixed-capacity row-sparse update (O(K), table never dense in
+        the gradient): gather the touched rows of the parameter and its
+        slots, run the per-row optimizer math, scatter back.  Correct for
+        every optimizer in the registry — their update rules are
+        elementwise, so a row block updates independently.  ``rows`` may
+        contain -1 padding (those slots are dropped).  SelectedRows
+        optimizer-kernel equivalent (``math/selected_rows_functor.cc``).
+
+        ``state = (count, slot_tuple)`` for THIS parameter — like
+        ``apply``'s state but with a single slot entry; thread the
+        returned state into the next step (Adam/Adamax bias correction
+        depends on the advancing count).  Initialize with
+        ``(jnp.zeros((), jnp.int32), opt.init({"t": table})[0])``.
+        """
+        from ..parallel.sparse import row_gather, row_scatter_set
+
+        lr = jnp.asarray(self.learning_rate if lr is None else lr,
+                         jnp.float32)
+        count, slot = state
+        count = count + 1
+        p_rows = row_gather(table, rows)
+        g = row_grads
+        if self.gradient_clipping_threshold > 0:
+            t = self.gradient_clipping_threshold
+            g = jnp.clip(g, -t, t)
+        if self.weight_decay:
+            g = g + self.weight_decay * p_rows
+        slot_rows = tuple(row_gather(s, rows) if s.shape == table.shape
+                          else s for s in slot)
+        np_, ns = self._update(p_rows, g, slot_rows, lr, count)
+        if self.l1_decay:
+            shrink = lr * self.l1_decay
+            np_ = jnp.sign(np_) * jnp.maximum(jnp.abs(np_) - shrink, 0.0)
+        new_table = row_scatter_set(table, rows, np_)
+        new_slot = tuple(
+            row_scatter_set(s, rows, n) if s.shape == table.shape else n
+            for s, n in zip(slot, ns))
+        return new_table, (count, new_slot)
 
     def init_state(self, params: PyTree) -> Tuple[jax.Array, list]:
         return (jnp.zeros((), jnp.int32), self.init(params))

@@ -158,6 +158,12 @@ class Trainer:
         net = self.network
         opt = self.optimizer
         lr_scales = self._lr_scales
+        # ParamAttr(sparse_update=True) → lazy row-sparse updates: only
+        # rows touched by the batch get value/moment updates (the
+        # SparseRowMatrix/SelectedRows contract, paddle/math/
+        # SparseRowMatrix.h:29; see paddle_tpu/parallel/sparse.py)
+        sparse_names = {n for n, s in net.param_specs.items()
+                        if s.sparse_update}
 
         def step(params, opt_state, buffers, feed, rng, progress):
             def loss_fn(p):
@@ -168,8 +174,14 @@ class Trainer:
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             lr = self.schedule(progress)
+            masks = None
+            if sparse_names:
+                from ..parallel.sparse import touched_row_mask
+                masks = {n: (touched_row_mask(g) if n in sparse_names
+                             else None)
+                         for n, g in grads.items()}
             new_params, new_opt = opt.apply(params, grads, opt_state, lr,
-                                            lr_scales)
+                                            lr_scales, sparse_masks=masks)
             return new_params, new_opt, new_buffers, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

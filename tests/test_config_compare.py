@@ -1,0 +1,140 @@
+"""Config-equivalence harness — two differently-expressed configs of the
+same network must produce identical outputs AND gradients.
+
+Reference: ``paddle/gserver/tests/test_NetworkCompare.cpp`` (conf pairs
+like concat_table vs concat_slice), ``paddle/trainer/tests/
+test_CompareTwoNets.cpp``.  Layers are named identically across the two
+expressions so default parameter names — and therefore seeded
+initialization — coincide.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.data.feeder import dense_vector, integer_value, \
+    integer_value_sequence
+from paddle_tpu.layers.network import NeuralNetwork
+
+
+def assert_configs_equivalent(build_a, build_b, feed, seed=9,
+                              rtol=1e-6):
+    """Build both topologies, share seeded init through matching param
+    names, compare loss and every parameter gradient."""
+    with config_scope():
+        cfg_a = build_a()
+    with config_scope():
+        cfg_b = build_b()
+    net_a, net_b = NeuralNetwork(cfg_a), NeuralNetwork(cfg_b)
+    pa, pb = net_a.init_params(seed=seed), net_b.init_params(seed=seed)
+    assert set(pa) == set(pb), (
+        f"param names differ: {sorted(pa)} vs {sorted(pb)} — name layers "
+        "identically so the harness can share initialization")
+    for n in pa:
+        assert pa[n].shape == pb[n].shape, n
+        np.testing.assert_array_equal(np.asarray(pa[n]),
+                                      np.asarray(pb[n]), err_msg=n)
+
+    def loss_and_grads(net, params):
+        buffers = net.init_buffers()
+
+        def lf(p):
+            loss, _ = net.loss(p, feed, buffers, is_training=False)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        return float(loss), grads
+
+    loss_a, ga = loss_and_grads(net_a, pa)
+    loss_b, gb = loss_and_grads(net_b, pb)
+    np.testing.assert_allclose(loss_a, loss_b, rtol=rtol)
+    for n in ga:
+        np.testing.assert_allclose(np.asarray(ga[n]), np.asarray(gb[n]),
+                                   rtol=rtol, atol=1e-6, err_msg=n)
+
+
+def _feed_dense(rng, dim=12, n=6, nclass=3):
+    return {"x": jnp.asarray(rng.randn(n, dim).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, nclass, (n,)))}
+
+
+def test_fc_equals_mixed_full_matrix_projection(rng):
+    """fc == mixed([full_matrix_projection]) (the canonical pair)."""
+
+    def build_fc():
+        x = dsl.data("x", dense_vector(12))
+        lab = dsl.data("label", integer_value(3))
+        h = dsl.fc(x, size=8, name="hid", act=dsl.TanhActivation(),
+                   bias_attr=True)
+        p = dsl.fc(h, size=3, name="out", act=dsl.SoftmaxActivation())
+        return dsl.topology(dsl.classification_cost(p, lab))
+
+    def build_mixed():
+        x = dsl.data("x", dense_vector(12))
+        lab = dsl.data("label", integer_value(3))
+        h = dsl.mixed([dsl.full_matrix_projection(x, size=8)], size=8,
+                      name="hid", act=dsl.TanhActivation(),
+                      bias_attr=True)
+        p = dsl.fc(h, size=3, name="out", act=dsl.SoftmaxActivation())
+        return dsl.topology(dsl.classification_cost(p, lab))
+
+    assert_configs_equivalent(build_fc, build_mixed, _feed_dense(rng))
+
+
+def test_direct_fc_equals_slice_concat(rng):
+    """x → fc == concat(slice(x,:6), slice(x,6:)) → fc (the
+    concat_slice.conf vs concat_table.conf pair)."""
+
+    def build_direct():
+        x = dsl.data("x", dense_vector(12))
+        lab = dsl.data("label", integer_value(3))
+        p = dsl.fc(x, size=3, name="out", act=dsl.SoftmaxActivation())
+        return dsl.topology(dsl.classification_cost(p, lab))
+
+    def build_sliced():
+        x = dsl.data("x", dense_vector(12))
+        lab = dsl.data("label", integer_value(3))
+        left = dsl.mixed([dsl.identity_projection(x, offset=0, size=6)],
+                         size=6, name="left")
+        right = dsl.mixed([dsl.identity_projection(x, offset=6, size=6)],
+                          size=6, name="right")
+        whole = dsl.concat([left, right], name="whole")
+        p = dsl.fc(whole, size=3, name="out", act=dsl.SoftmaxActivation())
+        return dsl.topology(dsl.classification_cost(p, lab))
+
+    assert_configs_equivalent(build_direct, build_sliced, _feed_dense(rng))
+
+
+def test_embedding_equals_table_projection(rng):
+    """embedding == mixed([table_projection]) over sequences."""
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    vocab = 30
+
+    def common_tail(emb, lab):
+        pooled = dsl.pooling(emb, pooling_type=dsl.AvgPooling())
+        p = dsl.fc(pooled, size=2, name="out",
+                   act=dsl.SoftmaxActivation())
+        return dsl.topology(dsl.classification_cost(p, lab))
+
+    def build_embedding():
+        ids = dsl.data("ids", integer_value_sequence(vocab))
+        lab = dsl.data("label", integer_value(2))
+        emb = dsl.embedding(ids, size=8, name="emb")
+        return common_tail(emb, lab)
+
+    def build_table():
+        ids = dsl.data("ids", integer_value_sequence(vocab))
+        lab = dsl.data("label", integer_value(2))
+        emb = dsl.mixed([dsl.table_projection(ids, size=8)], size=8,
+                        name="emb")
+        return common_tail(emb, lab)
+
+    ids = jnp.asarray(rng.randint(0, vocab, (4, 5)).astype(np.int32))
+    lens = jnp.asarray([5, 4, 3, 5], jnp.int32)
+    feed = {"ids": SequenceBatch(ids, lens),
+            "label": jnp.asarray(rng.randint(0, 2, (4,)))}
+    assert_configs_equivalent(build_embedding, build_table, feed)

@@ -1,0 +1,220 @@
+"""Row-sparse parameter path tests (paddle_tpu/parallel/sparse.py).
+
+Reference contracts verified:
+- SelectedRows merge/scatter (``paddle/framework/selected_rows.h:23``).
+- Lazy row-sparse optimizer updates — touched rows match the dense
+  update, untouched rows and their moment slots stay bit-identical
+  (``paddle/math/SparseRowMatrix.h:29`` sgdUpdate,
+  ``paddle/operators/math/selected_rows_functor.cc``).
+- Fixed-capacity prefetch (``RemoteParameterUpdater.h:265``): compute
+  and update in O(K) with the table absent from the gradient.
+- Sharded-table path on a multi-device mesh (the sparse-remote
+  large-model distribution, SURVEY §2.5 capability 4).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizers import OPTIMIZERS
+from paddle_tpu.parallel.sparse import (
+    SelectedRows, prefetch_rows, row_gather, row_scatter_add,
+    sparse_embedding_lookup, touched_row_mask, unique_rows)
+
+V, D = 50, 8
+
+
+def test_unique_rows_and_gather_roundtrip(rng):
+    ids = jnp.asarray(rng.randint(0, V, size=(4, 6)))
+    rows, inverse = jax.jit(lambda i: unique_rows(i, 32))(ids)
+    rows, inverse = np.asarray(rows), np.asarray(inverse)
+    assert (rows[inverse] == np.asarray(ids)).all()
+    real = rows[rows >= 0]
+    assert len(set(real.tolist())) == len(real)          # deduped
+    assert set(real.tolist()) == set(np.asarray(ids).ravel().tolist())
+
+
+def test_selected_rows_to_dense_accumulates_duplicates():
+    sr = SelectedRows(rows=jnp.asarray([3, 1, 3, -1]),
+                      values=jnp.ones((4, D)), height=V)
+    dense = np.asarray(sr.to_dense())
+    assert dense[3].sum() == 2 * D                       # dup rows add
+    assert dense[1].sum() == D
+    assert dense[0].sum() == 0                           # -1 pad ignored
+    assert np.count_nonzero(dense.sum(axis=1)) == 2
+
+
+def test_prefetch_lookup_matches_dense_take(rng):
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, V, size=(3, 5)))
+    rows, block, inverse = prefetch_rows(table, ids, capacity=32)
+    out = sparse_embedding_lookup(block, inverse)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.take(table, ids, axis=0)))
+
+
+@pytest.mark.parametrize("method", ["sgd", "momentum", "adagrad", "adam"])
+def test_lazy_masked_update_equivalence(rng, method):
+    """Masked (lazy) update == dense update on touched rows; untouched
+    rows and their moments bit-identical to the pre-update state."""
+    opt = OPTIMIZERS.get(method)(learning_rate=0.1)
+    p = {"emb": jnp.asarray(rng.randn(V, D).astype(np.float32))}
+    touched = np.array([2, 7, 31])
+    g_np = np.zeros((V, D), np.float32)
+    g_np[touched] = rng.randn(len(touched), D)
+    g = {"emb": jnp.asarray(g_np)}
+    state = opt.init_state(p)
+    mask = {"emb": touched_row_mask(g["emb"])}
+
+    p_dense, st_dense = opt.apply(p, g, state)
+    p_lazy, st_lazy = opt.apply(p, g, state, sparse_masks=mask)
+
+    pl, pd = np.asarray(p_lazy["emb"]), np.asarray(p_dense["emb"])
+    untouched = np.setdiff1d(np.arange(V), touched)
+    np.testing.assert_array_equal(pl[touched], pd[touched])
+    np.testing.assert_array_equal(pl[untouched],
+                                  np.asarray(p["emb"])[untouched])
+    # moment slots: untouched rows bit-identical to init
+    for s_old, s_new in zip(state[1][0], st_lazy[1][0]):
+        if np.shape(s_old) == (V, D):
+            np.testing.assert_array_equal(np.asarray(s_new)[untouched],
+                                          np.asarray(s_old)[untouched])
+
+
+@pytest.mark.parametrize("method", ["sgd", "adam"])
+def test_apply_rows_matches_lazy_dense(rng, method):
+    """Fixed-capacity O(K) row update == masked dense update."""
+    opt = OPTIMIZERS.get(method)(learning_rate=0.05)
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, V, size=(16,)))
+    rows, _ = unique_rows(ids, capacity=24)
+    row_g = jnp.asarray(rng.randn(24, D).astype(np.float32))
+    row_g = jnp.where((rows >= 0)[:, None], row_g, 0.0)
+
+    state = opt.init_state({"t": table})
+    row_state = (state[0], state[1][0])
+    new_table, (new_count, new_slot) = opt.apply_rows(table, rows, row_g,
+                                                      row_state)
+    assert int(new_count) == 1
+
+    g_dense = {"t": SelectedRows(rows, row_g, V).to_dense()}
+    mask = {"t": touched_row_mask(g_dense["t"], ids=ids)}
+    p_ref, st_ref = opt.apply({"t": table}, g_dense, state,
+                              sparse_masks=mask)
+    np.testing.assert_allclose(np.asarray(new_table),
+                               np.asarray(p_ref["t"]), rtol=1e-6)
+    for s_new, s_ref in zip(new_slot, st_ref[1][0]):
+        if np.shape(s_ref) == (V, D):
+            np.testing.assert_allclose(np.asarray(s_new),
+                                       np.asarray(s_ref), rtol=1e-6)
+
+
+def test_apply_rows_threads_count_multi_step(rng):
+    """Adam bias correction must advance across apply_rows steps — the
+    returned state carries the count (3 sparse steps == 3 masked dense
+    steps)."""
+    opt = OPTIMIZERS.get("adam")(learning_rate=0.05)
+    table = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    dense_p = {"t": table}
+    dense_st = opt.init_state(dense_p)
+    row_st = (dense_st[0], dense_st[1][0])
+    sp_table = table
+    for step in range(3):
+        ids = jnp.asarray(rng.randint(0, V, size=(16,)))
+        rows, _ = unique_rows(ids, capacity=24)
+        row_g = jnp.asarray(rng.randn(24, D).astype(np.float32))
+        row_g = jnp.where((rows >= 0)[:, None], row_g, 0.0)
+        sp_table, row_st = opt.apply_rows(sp_table, rows, row_g, row_st)
+        g_dense = {"t": SelectedRows(rows, row_g, V).to_dense()}
+        mask = {"t": touched_row_mask(g_dense["t"], ids=ids)}
+        dense_p, dense_st = opt.apply(dense_p, g_dense, dense_st,
+                                      sparse_masks=mask)
+    assert int(row_st[0]) == 3
+    np.testing.assert_allclose(np.asarray(sp_table),
+                               np.asarray(dense_p["t"]), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_trainer_sparse_update_leaves_untouched_rows(rng):
+    """End-to-end: ParamAttr(sparse_update=True) embedding — rows outside
+    the batch vocabulary never move (value or Adam moments)."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.config.model_config import OptimizationConfig
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.data.feeder import integer_value, \
+        integer_value_sequence
+    from paddle_tpu.layers.network import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+
+    vocab = 40
+    with config_scope():
+        x = dsl.data("ids", integer_value_sequence(vocab))
+        lab = dsl.data("label", integer_value(2))
+        emb = dsl.embedding(x, size=D, param_attr=dsl.ParamAttr(
+            name="sparse_emb", sparse_update=True, initial_std=0.1))
+        pooled = dsl.pooling(emb, pooling_type=dsl.MaxPooling())
+        pred = dsl.fc(pooled, size=2, act=dsl.SoftmaxActivation())
+        cost = dsl.classification_cost(pred, lab)
+        cfg = dsl.topology(cost)
+
+    net = NeuralNetwork(cfg)
+    tr = Trainer(net, opt_config=OptimizationConfig(
+        learning_method="adam", learning_rate=0.05), seed=0)
+    init_emb = np.asarray(tr.params["sparse_emb"]).copy()
+
+    used = np.arange(0, 10)                      # batch uses ids 0..9 only
+    ids = jnp.asarray(rng.choice(used, size=(4, 6)))
+    lengths = jnp.asarray([6, 6, 6, 6], jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 2, size=(4,)))
+    for _ in range(3):
+        tr.train_one_batch({"ids": SequenceBatch(ids, lengths),
+                            "label": labels})
+
+    emb_now = np.asarray(tr.params["sparse_emb"])
+    unused = np.arange(10, vocab)
+    np.testing.assert_array_equal(emb_now[unused], init_emb[unused])
+    assert np.abs(emb_now[np.asarray(ids).ravel()] -
+                  init_emb[np.asarray(ids).ravel()]).max() > 0
+
+
+def test_sharded_table_prefetch_dryrun(rng):
+    """The large-model path: a 'model'-axis row-sharded table on an
+    8-device mesh, O(K) prefetch + row update inside one jitted sharded
+    step; result equals the unsharded computation."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.core.device import build_mesh
+
+    mesh = build_mesh({"data": 4, "model": 2}, jax.devices()[:8])
+    big_v = 64
+    table = jnp.asarray(rng.randn(big_v, D).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, big_v, size=(8, 4)))
+    targets = jnp.asarray(rng.randn(8, 4, D).astype(np.float32))
+    opt = OPTIMIZERS.get("sgd")(learning_rate=0.1)
+
+    def step(table, ids, targets):
+        rows, block, inverse = prefetch_rows(table, ids, capacity=48)
+
+        def loss_fn(blk):
+            emb = sparse_embedding_lookup(blk, inverse)
+            return jnp.mean((emb - targets) ** 2)
+
+        loss, row_g = jax.value_and_grad(loss_fn)(block)
+        new_table, _ = opt.apply_rows(
+            table, rows, row_g, (jnp.zeros((), jnp.int32), ()))
+        return loss, new_table
+
+    ref_loss, ref_table = jax.jit(step)(table, ids, targets)
+
+    sharded_table = jax.device_put(
+        table, NamedSharding(mesh, P("model", None)))
+    sharded_ids = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+    sharded_t = jax.device_put(targets,
+                               NamedSharding(mesh, P("data", None, None)))
+    loss, new_table = jax.jit(step)(sharded_table, sharded_ids, sharded_t)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_table),
+                               np.asarray(ref_table), rtol=1e-5)
