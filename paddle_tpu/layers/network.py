@@ -160,6 +160,17 @@ class NeuralNetwork:
                     values[name if k == "out" else f"{name}.{k}"] = v
             else:
                 values[name] = out
+        # declared outputs that are group out-links with no downstream
+        # consumer still need their group to run
+        for name in self.output_names:
+            gname = self.group_of.get(name)
+            if name in values or gname is None or gname in done_groups:
+                continue
+            grp = self.groups.get(gname)
+            out_links = grp.out_links if grp is not None \
+                else self.gen_groups[gname].out_links
+            if name in out_links:
+                self._run_producer(name, params, values, ctx, done_groups)
         ctx.buffers.update(ctx.new_buffers)
         return values, ctx.buffers
 

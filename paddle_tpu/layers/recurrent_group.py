@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config.model_config import ModelConfig, SubModelConfig
-from ..core.sequence import SequenceBatch, value_of
+from ..core.sequence import NestedSequenceBatch, SequenceBatch, value_of
 from ..utils import ConfigError, enforce, layer_stack
 from .base import LAYERS, ForwardContext, Layer
 
@@ -93,6 +93,8 @@ class RecurrentGroup:
         """Scan the group over its in-link sequences; writes out-link
         sequences into ``values``."""
         enforce(self.in_links, f"group {self.sub.name} has no in_links")
+        if isinstance(values[self.in_links[0]], NestedSequenceBatch):
+            return self._run_nested(params, values, ctx)
         seqs = []
         for l in self.in_links:
             s = values[l]
@@ -124,9 +126,13 @@ class RecurrentGroup:
             new_mems, step_vals = self.step(params, frame_inputs, mems,
                                             outer, ctx)
             kept = [m * nm + (1 - m) * om for nm, om in zip(new_mems, mems)]
-            outs = {o: value_of(step_vals[o]) * \
-                    m.reshape((b,) + (1,) * (value_of(step_vals[o]).ndim - 1))
-                    for o in self.out_links}
+            outs = {}
+            for o in self.out_links:
+                d = value_of(step_vals[o])
+                mb = (m > 0).reshape((b,) + (1,) * (d.ndim - 1))
+                # where, not multiply: keeps integer out-links (maxid,
+                # sampling ids) in their own dtype
+                outs[o] = jnp.where(mb, d, jnp.zeros((), d.dtype))
             return kept, outs
 
         inp = dict(xs)
@@ -137,3 +143,83 @@ class RecurrentGroup:
             if self.sub.reversed:
                 data = data[:, ::-1]
             values[o] = SequenceBatch(data=data, length=length)
+
+    def _run_nested(self, params: Dict[str, jax.Array],
+                    values: Dict[str, Any], ctx: ForwardContext) -> None:
+        """Nested in-links (LoD level 2): the group steps over
+        SUBSEQUENCES — each scan frame is a whole ``SequenceBatch`` that
+        the step's sequence-aware layers (pooling, last_seq, recurrent
+        layers, nested groups) consume — exactly how
+        ``RecurrentGradientMachine`` sequences over
+        ``subSequenceStartPositions`` when in-links carry sub-sequence
+        info (``RecurrentGradientMachine.cpp`` createInFrameInfo /
+        ``test_RecurrentGradientMachine.cpp`` sequence_nest_rnn.conf).
+        Memories still carry [B, size] state across subsequences."""
+        seqs: List[NestedSequenceBatch] = []
+        for l in self.in_links:
+            s = values[l]
+            enforce(isinstance(s, NestedSequenceBatch),
+                    f"in_link {l!r}: all in-links of a nested group must "
+                    "be nested sequences")
+            seqs.append(s)
+        b = seqs[0].batch_size
+        num_subseq = seqs[0].num_subseq
+        outer_mask = seqs[0].subseq_mask(jnp.float32)        # [B, S]
+
+        mems0 = [self._memory_init(m, values, b, jnp.float32)
+                 for m in self.memories]
+
+        # scanned inputs: SequenceBatch pytrees with leading S axis
+        xs = {l: SequenceBatch(data=jnp.moveaxis(s.data, 1, 0),
+                               length=jnp.moveaxis(
+                                   s.sub_length *
+                                   s.subseq_mask(jnp.int32), 1, 0))
+              for l, s in zip(self.in_links, seqs)}
+        m_t = jnp.moveaxis(outer_mask, 1, 0)                 # [S, B]
+        if self.sub.reversed:
+            xs = {k: SequenceBatch(data=v.data[::-1],
+                                   length=v.length[::-1])
+                  for k, v in xs.items()}
+            m_t = m_t[::-1]
+
+        outer = values
+
+        def scan_fn(carry, inp):
+            mems = carry
+            frame_inputs = {l: inp[l] for l in self.in_links}
+            m = inp["__mask__"]                              # [B]
+            new_mems, step_vals = self.step(params, frame_inputs, mems,
+                                            outer, ctx)
+            kept = [m[:, None] * nm + (1 - m[:, None]) * om
+                    for nm, om in zip(new_mems, mems)]
+            outs = {}
+            for o in self.out_links:
+                v = step_vals[o]
+                d = value_of(v)
+                mb = (m > 0).reshape((b,) + (1,) * (d.ndim - 1))
+                d = jnp.where(mb, d, jnp.zeros((), d.dtype))
+                if isinstance(v, SequenceBatch):             # seq out-link
+                    outs[o] = SequenceBatch(
+                        data=d, length=v.length * (m > 0).astype(jnp.int32))
+                else:
+                    outs[o] = d
+            return kept, outs
+
+        inp: Dict[str, Any] = dict(xs)
+        inp["__mask__"] = m_t
+        _, stacked = jax.lax.scan(scan_fn, mems0, inp)
+        for o in self.out_links:
+            v = stacked[o]
+            if isinstance(v, SequenceBatch):
+                # [S, B, T, ...] → nested [B, S, T, ...]
+                data = jnp.moveaxis(v.data, 0, 1)
+                sub_len = jnp.moveaxis(v.length, 0, 1)
+                if self.sub.reversed:
+                    data, sub_len = data[:, ::-1], sub_len[:, ::-1]
+                values[o] = NestedSequenceBatch(
+                    data=data, num_subseq=num_subseq, sub_length=sub_len)
+            else:
+                data = jnp.moveaxis(v, 0, 1)                 # [B, S, ...]
+                if self.sub.reversed:
+                    data = data[:, ::-1]
+                values[o] = SequenceBatch(data=data, length=num_subseq)
