@@ -1,81 +1,279 @@
-"""Headline benchmark: LSTM text classifier training throughput.
+"""Headline benchmarks, matched to BASELINE.json's primary metrics.
 
-Mirrors the reference's RNN benchmark (``benchmark/paddle/rnn/rnn.py`` run
-via ``paddle train --job=time``): 2×LSTM + fc classifier, hidden=512,
-batch=128, seq len 100 — the ``benchmark/README.md:124-126`` row, 261
-ms/batch on 1× K40m.  Here the whole train step (fwd + autodiff bwd + Adam
-update) is ONE jitted XLA computation; we report steady-state ms/batch.
+Three workloads (the first printed line is the driver-parsed metric):
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline > 1 means faster than the reference baseline.
+1. **LSTM text classifier** training ms/batch — the reference RNN
+   benchmark (``benchmark/paddle/rnn/rnn.py`` via ``paddle train
+   --job=time``): 2×LSTM + fc, hidden=512, batch=128, T=100; reference
+   261 ms/batch on 1× K40m (``benchmark/README.md:124-126``).
+2. **ResNet-50** training samples/sec/chip (BASELINE.json primary 1) —
+   224² ImageNet shapes from the ``benchmark/paddle/image`` contract;
+   compared against published P40 ResNet-50 fp32 training throughput
+   (~95 images/sec, the BASELINE.md "P40" yardstick).
+3. **seq2seq** training tokens/sec (BASELINE.json primary 2) — bi-GRU
+   encoder + Bahdanau-attention GRU decoder (the ``demo/seqToseq`` /
+   WMT14 model at benchmark scale); the reference never published a
+   number ("will be added later", ``benchmark/README.md:141``), so
+   vs_baseline keys off the same P40-class yardstick via the reference
+   4-GPU LSTM row scaled to tokens (documented below).
+
+Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
+Adam).  Timing uses run-length differencing (time 1 step vs 1+N
+pipelined steps) because a single D2H sync over the axon tunnel costs
+~130 ms; a two-length consistency check (N and N/2 must agree) guards
+the method.  MFU is estimated from an analytic FLOP count over an
+assumed 197 TFLOP/s bf16 peak (v5e).
 """
 
+import argparse
 import json
 import time
 
 import jax
 import numpy as np
 
-BASELINE_MS = 261.0  # K40m, bs=128, hidden=512 (benchmark/README.md:124-126)
-BATCH, SEQLEN, HIDDEN, VOCAB, EMBED = 128, 100, 512, 30000, 128
-WARMUP, ITERS = 3, 20
+PEAK_FLOPS_BF16 = 197e12      # v5e chip peak, bf16
+TRAIN_FLOP_FACTOR = 3.0       # fwd + bwd ≈ 3× fwd matmul FLOPs
 
 
-def main():
+def _diff_time_ms(step_fn, warmup=3, iters=20, max_tries=3, tol=0.15):
+    """Marginal device ms/step via run-length differencing.
+
+    The N vs N/2 consistency check is ENFORCED: if the two run lengths
+    disagree by more than ``tol`` (tunnel hiccup, host contention), the
+    measurement retries with doubled iters; after ``max_tries`` the
+    best-agreeing attempt is reported, with its (failing) agreement
+    score so readers can see the number is soft."""
+    for _ in range(warmup):
+        step_fn(sync=True)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            step_fn(sync=(i == n - 1))
+        return (time.perf_counter() - t0) * 1000.0
+
+    best = None
+    for _ in range(max_tries):
+        base = min(run(1) for _ in range(3))
+        full = min(run(1 + iters) for _ in range(2))
+        half = min(run(1 + iters // 2) for _ in range(2))
+        ms = max((full - base) / iters, 1e-3)
+        ms_half = max((half - base) / (iters // 2), 1e-3)
+        agree = abs(ms - ms_half) / max(ms, ms_half)
+        if best is None or agree < best[1]:
+            best = (ms, agree)
+        if agree <= tol:
+            return ms, agree
+        iters *= 2
+    return best
+
+
+def _mk_trainer(cfg, lr=2e-3, clip=25.0, l2=0.0, mesh=None):
     from paddle_tpu.config.model_config import OptimizationConfig
-    from paddle_tpu.core.device import build_mesh, set_mesh
-    from paddle_tpu.core.sequence import SequenceBatch
     from paddle_tpu.layers.network import NeuralNetwork
-    from paddle_tpu.models import lstm_text_classifier
     from paddle_tpu.trainer.trainer import Trainer
 
+    net = NeuralNetwork(cfg)
+    return Trainer(net, opt_config=OptimizationConfig(
+        learning_method="adam", learning_rate=lr, l2_weight_decay=l2,
+        gradient_clipping_threshold=clip), mesh=mesh, seed=0)
+
+
+def _n_chips(trainer):
+    mesh = getattr(trainer, "mesh", None)
+    return int(mesh.devices.size) if mesh is not None else 1
+
+
+def bench_lstm():
+    from paddle_tpu.core.device import build_mesh, set_mesh
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import lstm_text_classifier
+
+    B, T, H, V, E = 128, 100, 512, 30000, 128
     devices = jax.devices()
     mesh = build_mesh({"data": len(devices)}, devices)
     set_mesh(mesh)
-
-    cfg = lstm_text_classifier(vocab_size=VOCAB, embed_dim=EMBED,
-                               hidden_size=HIDDEN, lstm_num=2, num_classes=2)
-    net = NeuralNetwork(cfg)
-    trainer = Trainer(
-        net,
-        opt_config=OptimizationConfig(learning_method="adam",
-                                      learning_rate=2e-3,
-                                      l2_weight_decay=8e-4,
-                                      gradient_clipping_threshold=25.0),
-        mesh=mesh, seed=0)
+    cfg = lstm_text_classifier(vocab_size=V, embed_dim=E, hidden_size=H,
+                               lstm_num=2, num_classes=2)
+    trainer = _mk_trainer(cfg, l2=8e-4, mesh=mesh)  # reference rnn.py decay
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, VOCAB, size=(BATCH, SEQLEN)).astype(np.int32)
-    lengths = rng.randint(SEQLEN // 2, SEQLEN + 1,
-                          size=(BATCH,)).astype(np.int32)
-    labels = rng.randint(0, 2, size=(BATCH,)).astype(np.int32)
-    feed = {"data": SequenceBatch(jax.numpy.asarray(ids),
-                                  jax.numpy.asarray(lengths)),
-            "label": jax.numpy.asarray(labels)}
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
+                jax.numpy.asarray(
+                    rng.randint(T // 2, T + 1, (B,)).astype(np.int32))),
+            "label": jax.numpy.asarray(rng.randint(0, 2, (B,)).astype(np.int32))}
 
-    for _ in range(WARMUP):
-        float(trainer.train_one_batch(feed))
+    def step(sync):
+        loss = trainer.train_one_batch(feed)
+        if sync:
+            float(loss)
 
-    def run(n):
-        """Time n pipelined steps ending in a forced D2H sync."""
-        t0 = time.perf_counter()
-        for _ in range(n):
-            loss = trainer.train_one_batch(feed)
-        float(loss)
-        return (time.perf_counter() - t0) * 1000.0
-
-    # Differencing removes the fixed host↔device sync overhead (large over
-    # the axon tunnel) so we report marginal device time per step.
-    base = min(run(1) for _ in range(3))
-    full = min(run(1 + ITERS) for _ in range(2))
-    ms = max((full - base) / ITERS, 1e-3)
-
-    print(json.dumps({
+    ms, agree = _diff_time_ms(step)
+    n = _n_chips(trainer)
+    # fwd matmul FLOPs: layer1 x-proj [B,E]→[B,4H] + h-proj [B,H]→[B,4H],
+    # layer2 both projections from H; per timestep, ×T
+    fwd = 2 * B * T * (E * 4 * H + H * 4 * H + H * 4 * H + H * 4 * H)
+    mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
+    return {
         "metric": "lstm_text_cls_ms_per_batch",
         "value": round(ms, 3),
         "unit": "ms/batch (bs=128, hidden=512, 2xLSTM, T=100)",
-        "vs_baseline": round(BASELINE_MS / ms, 3),
-    }))
+        "vs_baseline": round(261.0 / ms, 3),   # K40m bs=128 hid=512 row
+        "mfu_est": round(mfu, 3),
+        "devices": n,
+        "timing_self_check": round(agree, 3),
+    }
+
+
+def bench_resnet():
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector, integer_value
+    from paddle_tpu.models.image import resnet
+
+    B, IMG, NCLASS = 64, 224, 1000
+    with config_scope():
+        img = dsl.data("image", dense_vector(3 * IMG * IMG),
+                       height=IMG, width=IMG)
+        lab = dsl.data("label", integer_value(NCLASS))
+        probs = resnet(img, depth=50, num_classes=NCLASS)
+        cost = dsl.classification_cost(probs, lab)
+        cfg = dsl.topology(cost)
+    trainer = _mk_trainer(cfg, lr=1e-3)
+
+    rng = np.random.RandomState(0)
+    feed = {"image": jax.numpy.asarray(
+                rng.randn(B, 3 * IMG * IMG).astype(np.float32)),
+            "label": jax.numpy.asarray(
+                rng.randint(0, NCLASS, (B,)).astype(np.int32))}
+
+    def step(sync):
+        loss = trainer.train_one_batch(feed)
+        if sync:
+            float(loss)
+
+    ms, agree = _diff_time_ms(step, warmup=2, iters=10)
+    n = _n_chips(trainer)
+    sps_chip = B / (ms / 1e3) / n
+    fwd_flops_per_img = 3.8e9 * 2       # ~3.8 GMACs fwd @224²
+    mfu = TRAIN_FLOP_FACTOR * fwd_flops_per_img * sps_chip / PEAK_FLOPS_BF16
+    return {
+        "metric": "resnet50_samples_per_sec_per_chip",
+        "value": round(sps_chip, 1),
+        "unit": "samples/sec/chip (bs=64, 224x224, train step)",
+        "vs_baseline": round(sps_chip / 95.0, 3),  # published P40 fp32 ~95/s
+        "mfu_est": round(mfu, 3),
+        "devices": n,
+        "timing_self_check": round(agree, 3),
+    }
+
+
+def bench_seq2seq():
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import ParamAttr, StepInput, config_scope
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.data.feeder import integer_value_sequence
+    from paddle_tpu.v2.networks import simple_attention, simple_gru
+
+    B, S_LEN, T_LEN, V, E, H = 64, 30, 30, 30000, 512, 512
+
+    # the demo/seqToseq training topology at benchmark scale
+    with config_scope():
+        src = dsl.data("source", integer_value_sequence(V))
+        trg = dsl.data("target", integer_value_sequence(V))
+        trg_next = dsl.data("target_next", integer_value_sequence(V))
+        src_emb = dsl.embedding(src, size=E, name="src_emb",
+                                param_attr=ParamAttr(name="_src_emb"),
+                                vocab_size=V)
+        fwd = simple_gru(src_emb, size=H, name="enc_fwd")
+        bwd = simple_gru(src_emb, size=H, name="enc_bwd", reverse=True)
+        enc = dsl.concat([fwd, bwd], name="enc_seq")
+        enc_proj = dsl.fc(enc, size=H, act=dsl.LinearActivation(),
+                          bias_attr=False, name="enc_proj")
+        boot = dsl.fc(dsl.last_seq(bwd), size=H,
+                      act=dsl.TanhActivation(), name="dec_boot")
+        trg_emb = dsl.embedding(trg, size=E, name="trg_emb",
+                                param_attr=ParamAttr(name="_trg_emb"),
+                                vocab_size=V)
+
+        def step(e, ep, b, w):
+            mem = dsl.memory(name="dec_gru", size=H, boot_layer=b)
+            context = simple_attention(e, ep, mem.out, name="att")
+            inp = dsl.fc([context, w], size=H * 3,
+                         act=dsl.LinearActivation(), bias_attr=False,
+                         name="dec_inproj")
+            hidden = dsl.gru_step_layer(inp, mem.out, size=H,
+                                        name="dec_gru")
+            return dsl.fc(hidden, size=V, act=dsl.SoftmaxActivation(),
+                          name="dec_prob")
+
+        probs = dsl.recurrent_group(
+            step, [enc, enc_proj, boot, StepInput(trg_emb)],
+            name="decoder")
+        cost = dsl.classification_cost(probs, trg_next)
+        cfg = dsl.topology(cost)
+
+    trainer = _mk_trainer(cfg, lr=5e-4)
+    rng = np.random.RandomState(0)
+    feed = {
+        "source": SequenceBatch(
+            jax.numpy.asarray(rng.randint(2, V, (B, S_LEN)).astype(np.int32)),
+            jax.numpy.asarray(np.full((B,), S_LEN, np.int32))),
+        "target": SequenceBatch(
+            jax.numpy.asarray(rng.randint(2, V, (B, T_LEN)).astype(np.int32)),
+            jax.numpy.asarray(np.full((B,), T_LEN, np.int32))),
+        "target_next": SequenceBatch(
+            jax.numpy.asarray(rng.randint(2, V, (B, T_LEN)).astype(np.int32)),
+            jax.numpy.asarray(np.full((B,), T_LEN, np.int32))),
+    }
+
+    def step_fn(sync):
+        loss = trainer.train_one_batch(feed)
+        if sync:
+            float(loss)
+
+    ms, agree = _diff_time_ms(step_fn, warmup=2, iters=10)
+    n = _n_chips(trainer)
+    tokens_per_sec = B * T_LEN / (ms / 1e3)
+    # dominant matmuls fwd: encoder 2×GRU (3H gates from E and H) over
+    # S_LEN; decoder per step: attention proj + inproj (2H+E→3H) + GRU
+    # (H→3H) + softmax H→V
+    enc = 2 * 2 * B * S_LEN * (E * 3 * H + H * 3 * H)
+    dec = 2 * B * T_LEN * ((2 * H + E) * 3 * H + H * 3 * H + H * V)
+    mfu = TRAIN_FLOP_FACTOR * (enc + dec) / (ms / 1e3) / \
+        (PEAK_FLOPS_BF16 * n)
+    return {
+        "metric": "seq2seq_tokens_per_sec",
+        "value": round(tokens_per_sec, 0),
+        "unit": "target tokens/sec (bs=64, src=trg=30, hid=512, attn)",
+        # no in-tree reference number exists; yardstick = K40m 4-GPU
+        # LSTM hid=512 row (268 ms for 512×T=100 seqs ≈ 191k tok/s is
+        # unrealistic for attention seq2seq; we key off single-GPU
+        # hid=512 bs=256: 414 ms → 61.8k src tokens/s)
+        "vs_baseline": round(tokens_per_sec / 61800.0, 3),
+        "mfu_est": round(mfu, 3),
+        "devices": n,
+        "timing_self_check": round(agree, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=["lstm", "resnet", "seq2seq"])
+    args = ap.parse_args()
+    benches = {"lstm": bench_lstm, "resnet": bench_resnet,
+               "seq2seq": bench_seq2seq}
+    order = [args.only] if args.only else ["lstm", "resnet", "seq2seq"]
+    for name in order:
+        try:
+            print(json.dumps(benches[name]()), flush=True)
+        except Exception as e:          # noqa: BLE001 — report, don't die
+            if name == order[0]:
+                raise                   # the parsed line must be honest
+            print(json.dumps({"metric": name, "error": str(e)}),
+                  flush=True)
 
 
 if __name__ == "__main__":
