@@ -1,0 +1,97 @@
+"""Reference-config compatibility: the ``paddle.*`` import surface.
+
+Reference v1 configs begin with ``from paddle.trainer_config_helpers
+import *`` and their data providers with ``from paddle.trainer.
+PyDataProvider2 import *`` (e.g. ``benchmark/paddle/image/alexnet.py:3``,
+``provider.py:4``).  SURVEY §7 requires those files to run UNMODIFIED, so
+this module registers alias modules under ``sys.modules['paddle'...]``
+that re-export the TPU-native DSL / provider protocol.
+
+Because the era's configs are Python 2 (``xrange``, ``file``,
+``cPickle`` — ``benchmark/paddle/rnn/rnn.py:29``, ``imdb.py:38``),
+``install()`` also adds those three names as py2 compatibility shims
+(``builtins.xrange = range`` etc.) — they only exist in processes that
+opted into the v1 config path (CLI / config_parser).
+"""
+
+from __future__ import annotations
+
+import builtins
+import pickle
+import sys
+import types
+
+_installed = False
+
+
+class CacheType:
+    """``PyDataProvider2.CacheType`` (cache levels NO_CACHE /
+    CACHE_PASS_IN_MEM, ``python/paddle/trainer/PyDataProvider2.py``)."""
+
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+def _mk_module(name: str, attrs: dict) -> types.ModuleType:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    mod.__all__ = [k for k in attrs if not k.startswith("_")]
+    sys.modules[name] = mod
+    return mod
+
+
+def install() -> None:
+    """Idempotently register ``paddle``, ``paddle.trainer_config_helpers``,
+    ``paddle.trainer.PyDataProvider2`` aliases + py2 shims."""
+    global _installed
+    if _installed or "paddle" in sys.modules:
+        _installed = True
+        # py2 shims still needed even if a paddle module exists
+        _install_py2_shims()
+        return
+
+    import importlib
+
+    from ..config.config_parser import config_namespace
+    from ..data import feeder
+    provider_mod = importlib.import_module("paddle_tpu.data.provider")
+
+    helpers = config_namespace()
+    paddle = _mk_module("paddle", {})
+    trainer = _mk_module("paddle.trainer", {})
+    _mk_module("paddle.trainer_config_helpers", helpers)
+
+    pdp2 = {
+        "provider": provider_mod.provider,
+        "CacheType": CacheType,
+    }
+    for k in ("dense_vector", "integer_value", "integer_value_sequence",
+              "sparse_binary_vector", "sparse_float_vector",
+              "dense_vector_sequence", "sparse_binary_vector_sequence",
+              "sparse_float_vector_sequence"):
+        if hasattr(feeder, k):
+            pdp2[k] = getattr(feeder, k)
+    _mk_module("paddle.trainer.PyDataProvider2", pdp2)
+
+    from ..config import config_parser
+    _mk_module("paddle.trainer.config_parser",
+               {"parse_config": config_parser.parse_config})
+
+    paddle.trainer = trainer
+    paddle.trainer_config_helpers = sys.modules[
+        "paddle.trainer_config_helpers"]
+    trainer.PyDataProvider2 = sys.modules["paddle.trainer.PyDataProvider2"]
+    trainer.config_parser = sys.modules["paddle.trainer.config_parser"]
+
+    _install_py2_shims()
+    _installed = True
+
+
+def _install_py2_shims() -> None:
+    if not hasattr(builtins, "xrange"):
+        builtins.xrange = range
+    if not hasattr(builtins, "file"):
+        builtins.file = open
+    if "cPickle" not in sys.modules:
+        sys.modules["cPickle"] = pickle

@@ -183,6 +183,12 @@ def config_namespace() -> Dict[str, Any]:
     for k in dir(dsl):
         if not k.startswith("_"):
             ns[k] = getattr(dsl, k)
+    # trainer_config_helpers.networks composites (vgg.py/rnn.py use them)
+    from ..v2 import networks as _networks
+    for k in dir(_networks):
+        if not k.startswith("_") and callable(getattr(_networks, k)) \
+                and k not in ns:
+            ns[k] = getattr(_networks, k)
     from ..data import feeder
     for k in ("dense_vector", "integer_value", "integer_value_sequence",
               "sparse_binary_vector", "sparse_float_vector",
@@ -205,6 +211,8 @@ def parse_config(config_path: str, config_args: str = ""):
     """Execute a config file → (ModelConfig, OptimizationConfig,
     DataSources).  The reference embeds CPython to do this
     (``TrainerConfigHelper`` → ``parse_config``); here it's just exec."""
+    from ..compat import install as _install_compat
+    _install_compat()   # 'from paddle.trainer_config_helpers import *'
     _state.reset()
     _state.config_args = parse_config_args(config_args)
     with dsl.config_scope():

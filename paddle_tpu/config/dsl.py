@@ -257,8 +257,20 @@ def _add_layer(name: Optional[str], ltype: str, size: int,
 # ------------------------------------------------------------ data layer
 
 
-def data(name: str, type, height: int = 0, width: int = 0) -> LayerOutput:
-    """``data_layer``; ``type`` is a :class:`paddle_tpu.data.InputType`."""
+def data(name: str, type=None, height: int = 0, width: int = 0,
+         size: Optional[int] = None, **_ignored) -> LayerOutput:
+    """``data_layer``.  Two calling conventions:
+
+    - v2 style: ``type`` is a :class:`paddle_tpu.data.InputType`;
+    - v1 style (reference configs): ``data_layer('x', size=N)`` — the
+      actual input type comes from the data provider's input_types.
+    """
+    if isinstance(type, int):           # v1 positional: data_layer(name, size)
+        size, type = type, None
+    if type is None:
+        enforce(size is not None, f"data layer {name!r}: pass type= or size=")
+        from ..data.feeder import dense_vector
+        type = dense_vector(size)
     conf = LayerConfig(name=name, type="data", size=type.dim,
                        attrs={"height": height, "width": width,
                               "seq_level": type.seq_level, "kind": type.kind})
@@ -311,10 +323,11 @@ addto_layer = addto
 
 
 def concat(input: Input, act=None, name: Optional[str] = None,
-           layer_attr=None) -> LayerOutput:
+           bias_attr=False, layer_attr=None) -> LayerOutput:
     ins = _as_list(input)
     return _add_layer(name, "concat", sum(i.size for i in ins),
-                      _mk_inputs(ins), act, layer_attr=layer_attr)
+                      _mk_inputs(ins), act, bias_attr,
+                      layer_attr=layer_attr)
 
 
 concat_layer = concat
@@ -325,6 +338,11 @@ def dropout(input: Input, dropout_rate: float = 0.5,
     """v2 ``dropout`` = addto with drop_rate."""
     return addto(input, name=name,
                  layer_attr=ExtraAttr(drop_rate=dropout_rate))
+
+
+def dropout_layer(input: Input, dropout_rate: float = 0.5,
+                  name: Optional[str] = None) -> LayerOutput:
+    return dropout(input, dropout_rate, name)
 
 
 # ------------------------------------------------------------------ mixed
@@ -433,6 +451,21 @@ def img_conv(input: Input, filter_size: int, num_filters: int,
 
 
 img_conv_layer = img_conv
+
+
+def conv_projection(input: Input, filter_size: int, num_filters: int,
+                    num_channels: Optional[int] = None, stride: int = 1,
+                    padding: int = 0,
+                    param_attr: Optional[ParamAttr] = None,
+                    name: Optional[str] = None) -> LayerOutput:
+    """``conv_projection`` (reference ``ConvProjection``): a bias-free
+    linear convolution.  The reference materializes it inside the
+    consuming concat/mixed layer; here it is its own conv layer — the
+    concat of projection outputs is identical math."""
+    return img_conv(input, filter_size, num_filters,
+                    num_channels=num_channels, stride=stride,
+                    padding=padding, act=LinearActivation(),
+                    bias_attr=False, param_attr=param_attr, name=name)
 
 
 def conv_out(img: int, filt: int, pad: int, stride: int) -> int:

@@ -132,7 +132,14 @@ class AddtoLayer(Layer):
 class ConcatLayer(Layer):
     def forward(self, params, inputs, ctx):
         vals = [value_of(x) for x in inputs]
-        return self.finalize(like(inputs[0], jnp.concatenate(vals, axis=-1)), ctx)
+        out = jnp.concatenate(vals, axis=-1)
+        if self.conf.with_bias:   # googlenet inception: concat+bias+relu
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+    def param_specs(self):
+        return [self._bias_spec((self.conf.size,))] \
+            if self.conf.with_bias else []
 
 
 @register_layer("mixed")

@@ -56,14 +56,16 @@ def simple_img_conv_pool(input, filter_size: int, num_filters: int,
 def img_conv_group(input, conv_num_filter: Sequence[int],
                    conv_filter_size: int = 3, num_channels=None,
                    pool_size: int = 2, pool_stride: int = 2,
-                   conv_act=None, conv_with_batchnorm: bool = False,
+                   conv_padding: int = 1, conv_act=None,
+                   conv_with_batchnorm: bool = False,
                    conv_batchnorm_drop_rate=None, pool_type=None,
-                   img_size: Optional[int] = None):
+                   img_size: Optional[int] = None, **_ignored):
     tmp = input
     channels = num_channels
     for i, nf in enumerate(conv_num_filter):
         tmp = img_conv(tmp, filter_size=conv_filter_size, num_filters=nf,
-                       num_channels=channels, padding=1, img_size=img_size,
+                       num_channels=channels, padding=conv_padding,
+                       img_size=img_size,
                        act=LinearActivation() if conv_with_batchnorm
                        else (conv_act or ReluActivation()))
         img_size = None
