@@ -90,11 +90,22 @@ class DataFeeder:
             return out
         return np.asarray(row, np.float32)
 
+    @staticmethod
+    def _materialize(row):
+        """List-ify one-shot iterators (py2-era providers ``yield
+        map(int, xs)`` — ``benchmark/paddle/rnn/provider.py:72``)."""
+        if isinstance(row, (list, tuple, np.ndarray, int, float, str,
+                            bytes)):
+            return row
+        if hasattr(row, "__iter__"):
+            return list(row)
+        return row
+
     def convert(self, batch: List[Sequence]) -> Dict[str, Any]:
         """minibatch (list of sample tuples) → feed dict."""
         feed: Dict[str, Any] = {}
         for slot, (name, itype) in enumerate(self.feeding):
-            col = [sample[slot] for sample in batch]
+            col = [self._materialize(sample[slot]) for sample in batch]
             if itype.seq_level == 0:
                 if itype.kind == "index":
                     feed[name] = jnp.asarray(np.asarray(col, np.int32))

@@ -16,6 +16,27 @@ from typing import Any, Callable, Optional, Sequence
 from .feeder import InputType
 
 
+class Settings:
+    """Provider settings object handed to ``init_hook`` / the generator.
+
+    The reference's init hooks set either ``settings.input_types`` or the
+    older alias ``settings.slots`` (``python/paddle/trainer/
+    PyDataProvider2.py``, used by ``benchmark/paddle/image/provider.py:18``)
+    — keep both names pointing at the same list.
+    """
+
+    def __init__(self, input_types=None):
+        self.input_types = input_types
+
+    @property
+    def slots(self):
+        return self.input_types
+
+    @slots.setter
+    def slots(self, value):
+        self.input_types = value
+
+
 class ProviderWrapper:
     def __init__(self, generator: Callable, input_types, cache: bool,
                  should_shuffle: bool, pool_size: int,
@@ -27,8 +48,7 @@ class ProviderWrapper:
         self.pool_size = pool_size
         self.init_hook = init_hook
         self._cached = None
-        self.settings = type("Settings", (), {})()
-        self.settings.input_types = input_types
+        self.settings = Settings(input_types)
 
     def reader(self, *file_list, **kwargs):
         """Build a reader over the provider's generator."""

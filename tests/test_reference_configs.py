@@ -1,0 +1,83 @@
+"""Reference benchmark configs must run UNMODIFIED (SURVEY §7).
+
+The five configs under ``/root/reference/benchmark/paddle/`` —
+``image/{alexnet,googlenet,vgg,smallnet_mnist_cifar}.py`` and
+``rnn/rnn.py`` — are the perf contract (``benchmark/paddle/image/run.sh``
+drives ``paddle train --job=time`` over them).  These tests parse every
+one of them through the v1 config protocol with zero edits, and drive a
+real ``--job=time`` run from the reference smallnet config using the
+reference's own ``provider.py`` data provider.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.config.config_parser import parse_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference/benchmark/paddle"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference tree not mounted")
+
+IMAGE_CONFIGS = {
+    # config name -> (min layer count, a layer type it must contain)
+    "alexnet": (16, "norm"),
+    "googlenet": (80, "concat"),
+    "vgg": (25, "pool"),
+    "smallnet_mnist_cifar": (10, "exconv"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(IMAGE_CONFIGS))
+def test_parse_reference_image_config(name):
+    model, opt, ds = parse_config(
+        os.path.join(REF, "image", f"{name}.py"), "batch_size=8")
+    min_layers, must_have = IMAGE_CONFIGS[name]
+    types = [l.type for l in model.layers]
+    assert len(model.layers) >= min_layers, types
+    assert must_have in types, types
+    assert opt.batch_size == 8
+    assert ds is not None and ds.module == "provider"
+
+
+def test_parse_reference_rnn_config(tmp_path, monkeypatch):
+    """rnn.py calls ``imdb.create_data`` at parse time; seed the files it
+    checks for (as a prepared run would have) and parse unmodified."""
+    train = ([[1, 2, 3], [4, 5]], [0, 1])
+    for fname in ("imdb.train.pkl", "imdb.test.pkl"):
+        with open(tmp_path / fname, "wb") as f:
+            pickle.dump(train, f)
+    (tmp_path / "train.list").write_text("imdb.train.pkl\n")
+    monkeypatch.chdir(tmp_path)
+    model, opt, ds = parse_config(
+        os.path.join(REF, "rnn", "rnn.py"),
+        "batch_size=4,lstm_num=2,hidden_size=32")
+    types = [l.type for l in model.layers]
+    assert types.count("lstmemory") == 2, types
+    assert "embedding" in types and "seqlastins" in types, types
+    assert opt.learning_method == "adam"
+
+
+def test_time_job_from_reference_config(tmp_path):
+    """End-to-end ``--job=time`` driven by the reference smallnet config
+    AND the reference image provider.py (xrange, settings.slots,
+    CACHE_PASS_IN_MEM — all py2-era idioms must work through compat)."""
+    (tmp_path / "train.list").write_text("dummy\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "train",
+         "--config", os.path.join(REF, "image", "smallnet_mnist_cifar.py"),
+         "--job", "time", "--test_period", "4",
+         "--config_args", "batch_size=16"],
+        capture_output=True, text=True, timeout=500, cwd=tmp_path, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["job"] == "time" and out["samples_per_sec"] > 0
