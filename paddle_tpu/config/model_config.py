@@ -52,6 +52,8 @@ class ProjConfig:
     trainable_padding: bool = False
     slice_begin: int = 0
     slice_end: int = 0
+    # multi-slice form (SliceProjection concatenates selected ranges)
+    slices: Optional[List[Tuple[int, int]]] = None
 
 
 @dataclass

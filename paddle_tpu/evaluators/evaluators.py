@@ -345,3 +345,177 @@ class CTCErrorEvaluator(Evaluator):
 
 def create_evaluator(name: str, **kwargs) -> Evaluator:
     return EVALUATORS.create(name, **kwargs)
+
+
+@EVALUATORS.register("detection_map")
+class DetectionMAPEvaluator(Evaluator):
+    """SSD mean-average-precision (``DetectionMAPEvaluator.cpp``): streams
+    (score, TP/FP) pairs per class, AP by 11-point or natural integral.
+
+    ``eval_batch(output, label)`` takes the ``detection_output`` layer's
+    [B, K, 7] rows (image,class,score,xmin,ymin,xmax,ymax; image -1 =
+    empty slot) and the padded GT SequenceBatch [B, G, 6]."""
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 background_id: int = 0, evaluate_difficult: bool = False,
+                 ap_type: str = "11point", **kw):
+        self.overlap_threshold = overlap_threshold
+        self.background_id = background_id
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_type = ap_type
+        super().__init__(**kw)
+
+    def start(self):
+        self.score_tp = {}      # class -> list of (score, is_tp)
+        self.num_gt = {}        # class -> count
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def eval_batch(self, output, label, weight=None):
+        det, _ = self._to_np(output)
+        gt, gt_mask = self._to_np(label)
+        B = gt.shape[0]
+        for b in range(B):
+            n = int(gt_mask[b].sum()) if gt_mask is not None else gt.shape[1]
+            rows = gt[b, :n]
+            for r in rows:
+                c = int(r[0])
+                difficult = len(r) > 5 and r[5] > 0.5
+                if self.evaluate_difficult or not difficult:
+                    self.num_gt[c] = self.num_gt.get(c, 0) + 1
+            matched = [False] * n
+            dets = det[b]
+            dets = dets[dets[:, 0] >= 0]
+            # evaluate detections best-score first (reference sorts)
+            for d in dets[np.argsort(-dets[:, 2])]:
+                c = int(d[1])
+                if c == self.background_id:
+                    continue
+                best, best_i = 0.0, -1
+                for i, r in enumerate(rows):
+                    if int(r[0]) != c:
+                        continue
+                    ov = self._iou(d[3:7], r[1:5])
+                    if ov > best:
+                        best, best_i = ov, i
+                tp = False
+                if best > self.overlap_threshold and best_i >= 0:
+                    difficult = len(rows[best_i]) > 5 and rows[best_i][5] > 0.5
+                    if difficult and not self.evaluate_difficult:
+                        continue   # reference skips difficult matches
+                    if not matched[best_i]:
+                        tp = True
+                        matched[best_i] = True
+                self.score_tp.setdefault(c, []).append((float(d[2]), tp))
+
+    def _average_precision(self, pairs, n_gt):
+        if not pairs or n_gt == 0:
+            return 0.0
+        pairs = sorted(pairs, key=lambda p: -p[0])
+        tp = np.cumsum([1.0 if t else 0.0 for _, t in pairs])
+        fp = np.cumsum([0.0 if t else 1.0 for _, t in pairs])
+        recall = tp / n_gt
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        if self.ap_type == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                mask = recall >= t
+                ap += (precision[mask].max() if mask.any() else 0.0) / 11.0
+            return float(ap)
+        # natural integral
+        ap, prev_r = 0.0, 0.0
+        for r, p in zip(recall, precision):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return float(ap)
+
+    def get_value(self):
+        aps = [self._average_precision(self.score_tp.get(c, []), n)
+               for c, n in self.num_gt.items() if n > 0]
+        return {"detection_map": float(np.mean(aps) * 100) if aps else 0.0}
+
+
+class _PrinterEvaluator(Evaluator):
+    """Base for the printer family (``Evaluator.cpp`` toString
+    evaluators): accumulates printable lines, logs at finish."""
+
+    def start(self):
+        self.lines = []
+
+    def get_value(self):
+        for line in self.lines:
+            print(line)
+        return {}
+
+
+@EVALUATORS.register("value_printer")
+class ValuePrinterEvaluator(_PrinterEvaluator):
+    def eval_batch(self, output, label=None, weight=None):
+        out, _ = self._to_np(output)
+        self.lines.append(f"value: {np.array2string(out, threshold=64)}")
+
+
+@EVALUATORS.register("gradient_printer")
+class GradientPrinterEvaluator(_PrinterEvaluator):
+    def eval_batch(self, output, label=None, weight=None):
+        out, _ = self._to_np(output)
+        self.lines.append(f"gradient: {np.array2string(out, threshold=64)}")
+
+
+@EVALUATORS.register("maxid_printer")
+class MaxIdPrinterEvaluator(_PrinterEvaluator):
+    def eval_batch(self, output, label=None, weight=None):
+        out, _ = self._to_np(output)
+        ids = out.argmax(-1)
+        self.lines.append(f"maxid: {np.array2string(ids, threshold=64)}")
+
+
+@EVALUATORS.register("maxframe_printer")
+class MaxFramePrinterEvaluator(_PrinterEvaluator):
+    def eval_batch(self, output, label=None, weight=None):
+        out, mask = self._to_np(output)
+        frames = out.max(-1) if out.ndim > 2 else out
+        self.lines.append(f"maxframe: {np.array2string(frames, threshold=64)}")
+
+
+@EVALUATORS.register("seq_text_printer")
+class SeqTextPrinterEvaluator(_PrinterEvaluator):
+    """Prints id sequences, optionally mapped through a dict file
+    (``--dict_file`` in the reference)."""
+
+    def __init__(self, dict_file=None, **kw):
+        self.id2word = None
+        if dict_file:
+            with open(dict_file) as f:
+                self.id2word = [w.rstrip("\n") for w in f]
+        super().__init__(**kw)
+
+    def eval_batch(self, output, label=None, weight=None):
+        out, mask = self._to_np(output)
+        ids = out.argmax(-1) if out.ndim == 3 else out.astype(np.int64)
+        for b in range(ids.shape[0]):
+            n = int(mask[b].sum()) if mask is not None else ids.shape[1]
+            toks = [int(t) for t in np.atleast_1d(ids[b])[:n]]
+            if self.id2word:
+                words = [self.id2word[t] if 0 <= t < len(self.id2word)
+                         else "<unk>" for t in toks]
+                self.lines.append(" ".join(words))
+            else:
+                self.lines.append(" ".join(map(str, toks)))
+
+
+@EVALUATORS.register("classification_error_printer")
+class ClassificationErrorPrinterEvaluator(_PrinterEvaluator):
+    def eval_batch(self, output, label, weight=None):
+        out, _ = self._to_np(output)
+        lab, _ = self._to_np(label)
+        err = (out.argmax(-1) != lab.squeeze().astype(np.int64))
+        self.lines.append(
+            f"classification_error: {np.array2string(err.astype(np.float32))}")

@@ -69,7 +69,7 @@ class DataLayer(Layer):
         raise ConfigError("data layers are fed, not computed")
 
 
-@register_layer("fc")
+@register_layer("fc", "mkldnn_fc")
 class FullyConnectedLayer(Layer):
     """``FullyConnectedLayer``: out = act(sum_i x_i W_i + b)."""
 
@@ -166,6 +166,11 @@ class MixedLayer(Layer):
             if p.type == "fc":
                 specs.append(self._weight_spec(
                     i, (p.input_size, self.conf.size), initial_smart=True))
+            elif p.type == "trans_fc":
+                # TransposedFullMatrixProjection: W is [out, in], applied
+                # transposed (trainer_config_helpers trans_full_matrix_projection)
+                specs.append(self._weight_spec(
+                    i, (self.conf.size, p.input_size), initial_smart=True))
             elif p.type == "dot_mul":
                 specs.append(self._weight_spec(i, (self.conf.size,),
                                                initial_mean=1.0, initial_std=0.0))
@@ -193,6 +198,10 @@ class MixedLayer(Layer):
             if p.type == "fc":
                 y = _flat_apply(lambda t: math_ops.matmul(t, params[self.weight_name(i)]), x)
                 y = value_of(y)
+            elif p.type == "trans_fc":
+                y = _flat_apply(lambda t: math_ops.matmul(
+                    t, params[self.weight_name(i)].T), x)
+                y = value_of(y)
             elif p.type == "identity":
                 y = v
             elif p.type == "dot_mul":
@@ -209,16 +218,50 @@ class MixedLayer(Layer):
                     x, p.context_start, p.context_length, pad_w))
                 template = x
             elif p.type == "slice":
-                y = v[..., p.slice_begin:p.slice_end]
+                slices = getattr(p, "slices", None) or \
+                    [(p.slice_begin, p.slice_end)]
+                y = jnp.concatenate([v[..., b:e] for b, e in slices], axis=-1)
             else:
                 raise ConfigError(f"unknown projection type {p.type!r}")
             out = y if out is None else out + y
         if self.conf.attrs.get("dot_mul_operator"):
             out = value_of(inputs[0]) * value_of(inputs[1]) * \
                 self.conf.attrs.get("dotmul_scale", 1.0)
+        for op in self.conf.attrs.get("operators", []):
+            out = self._apply_operator(op, inputs, out)
         if self.conf.with_bias:
             out = out + params[self.bias_name()]
         return self.finalize(like(template, out), ctx)
+
+    def _apply_operator(self, op: Dict[str, Any], inputs, out):
+        """Operator inside a mixed layer (``ConvOperator``/``DotMulOperator``
+        — operators read other inputs' values, own no parameters)."""
+        kind = op["type"]
+        ia, ib = op.get("input_indices", (0, 1))
+        a = value_of(inputs[ia])
+        b = value_of(inputs[ib])
+        if kind == "dot_mul":
+            y = a * b * op.get("scale", 1.0)
+        elif kind == "conv":
+            from ..ops import nn_ops
+            from .conv import to_nhwc
+            c = op["channels"]
+            h = op.get("img_size_y", op.get("img_size"))
+            w = op.get("img_size")
+            fh = op.get("filter_size_y", op["filter_size"])
+            fw = op["filter_size"]
+            nf = op["num_filters"]
+            x = to_nhwc(a, c, h, w)
+            # the filter comes from a layer's VALUE (shared across the
+            # batch), not a parameter — ConvOperator semantics
+            filt = b.reshape(-1)[: fh * fw * c * nf]
+            filt = filt.reshape(nf, c, fh, fw).transpose(2, 3, 1, 0)
+            y = nn_ops.conv2d(x, filt, stride=op.get("stride", 1),
+                              padding=[(op.get("padding", 0),) * 2] * 2)
+            y = y.reshape(y.shape[0], -1)
+        else:
+            raise ConfigError(f"unknown mixed operator {kind!r}")
+        return y if out is None else out + y
 
 
 @register_layer("selective_fc")
@@ -510,3 +553,15 @@ class PrintLayer(Layer):
     def forward(self, params, inputs, ctx):
         jax.debug.print(self.name + ": {}", value_of(inputs[0]))
         return inputs[0]
+
+
+@register_layer("conv_shift")
+class ConvShiftLayer(Layer):
+    """Circular convolution of each row of a with kernel row b
+    (``ConvShiftLayer.cpp``; NTM addressing): b width must be odd."""
+
+    def forward(self, params, inputs, ctx):
+        from ..ops.math_ops import conv_shift
+        a = value_of(inputs[0])
+        b = value_of(inputs[1])
+        return self.finalize(like(inputs[0], conv_shift(a, b)), ctx)

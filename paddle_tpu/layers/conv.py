@@ -61,7 +61,7 @@ class _ImgLayer(Layer):
         return val
 
 
-@register_layer("exconv", "cudnn_conv", "conv")
+@register_layer("exconv", "cudnn_conv", "conv", "mkldnn_conv")
 class ConvLayer(_ImgLayer):
     def _shapes(self):
         c = self.geo("channels")
@@ -124,7 +124,7 @@ class ConvTransLayer(_ImgLayer):
         return self.finalize(like(inputs[0], out), ctx)
 
 
-@register_layer("pool", "cudnn_pool")
+@register_layer("pool", "cudnn_pool", "mkldnn_pool")
 class PoolLayer(_ImgLayer):
     def forward(self, params, inputs, ctx):
         c = self.geo("channels")
@@ -301,3 +301,24 @@ class BilinearInterpLayer(_ImgLayer):
         out = nn_ops.bilinear_interp(
             x, self.geo("out_size_y"), self.geo("out_size_x"))
         return like(inputs[0], out)
+
+
+@register_layer("cross-channel-norm")
+class CrossChannelNormLayer(_ImgLayer):
+    """Per-position L2 normalization across channels with a learned
+    per-channel scale (``CrossChannelNormLayer.cpp``; SSD conv4_3 norm):
+    ``out[c, s] = scale[c] * x[c, s] / sqrt(sum_c x[c, s]^2 + 1e-6)``."""
+
+    def param_specs(self):
+        c = self.geo("channels")
+        return [self._weight_spec(0, (c,), initial_mean=1.0,
+                                  initial_std=0.0)]
+
+    def forward(self, params, inputs, ctx):
+        c = self.geo("channels")
+        v = value_of(inputs[0])
+        b = v.shape[0]
+        x = v.reshape(b, c, -1)  # [B, C, spatial]
+        norm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True) + 1e-6)
+        out = x / norm * params[self.weight_name(0)][None, :, None]
+        return self.finalize(like(inputs[0], out.reshape(v.shape)), ctx)

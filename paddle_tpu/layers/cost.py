@@ -204,3 +204,60 @@ class CTCCost(_CostBase):
             blank=self.conf.attrs.get("blank", 0),
             norm_by_times=self.conf.attrs.get("norm_by_times", False))
         return _per_example(cost, logits)
+
+
+@register_layer("cross_entropy_over_beam")
+class CrossEntropyOverBeamCost(Layer):
+    """Globally-normalized beam cross-entropy
+    (``CrossEntropyOverBeam.cpp``; Andor et al., "Globally Normalized
+    Transition-Based Neural Networks").
+
+    Inputs come in groups of three per beam expansion, mirroring the
+    reference's ``BeamInput`` triples: (candidate path scores [B, K],
+    candidate ids [B, K], gold id [B]).  Scores are **accumulated** path
+    scores at that expansion (our in-graph ``beam_gen`` decoder tracks
+    them directly; the reference reconstructs the accumulation from
+    per-expansion scores + parent rows host-side —
+    ``CostForOneSequence::globallyNormalizedScore``).
+
+    Per sequence: follow the gold id through the expansions; at the first
+    expansion where gold leaves the beam (``calValidExpandStep``), the
+    cost is computed there with the gold path appended as an extra
+    candidate (``goldAsExtraPath_``); if gold survives to the last
+    expansion the cost is the softmax CE over the final beam at gold's
+    slot.  Cost = -log softmax(path scores)[gold].
+    """
+
+    def forward(self, params, inputs, ctx):
+        enforce(len(inputs) % 3 == 0,
+                "cross_entropy_over_beam takes (scores, ids, gold) triples")
+        n_exp = len(inputs) // 3
+        triples = [(value_of(inputs[3 * i]), value_of(inputs[3 * i + 1]),
+                    value_of(inputs[3 * i + 2])) for i in range(n_exp)]
+        b = triples[0][0].shape[0]
+
+        # state per sequence: cost once gold drops out (frozen), else the
+        # final-beam CE; gold_alive tracks beam membership
+        alive = jnp.ones((b,), bool)
+        frozen_cost = jnp.zeros((b,), jnp.float32)
+        gold_score = jnp.zeros((b,), jnp.float32)
+        for scores, ids, gold in triples:
+            scores = scores.astype(jnp.float32)
+            gold_i = gold.reshape(b).astype(ids.dtype)
+            hit = ids == gold_i[:, None]                       # [B, K]
+            in_beam = jnp.any(hit, axis=1)
+            g_here = jnp.sum(jnp.where(hit, scores, 0.0), axis=1)
+            gold_score = jnp.where(alive & in_beam, g_here, gold_score)
+            # CE at this expansion with gold as the extra path
+            ext = jnp.concatenate([scores, gold_score[:, None]], axis=1)
+            lse_ext = jax.nn.logsumexp(ext, axis=1)
+            drop_cost = -(gold_score - lse_ext)
+            dropping = alive & (~in_beam)
+            frozen_cost = jnp.where(dropping, drop_cost, frozen_cost)
+            alive = alive & in_beam
+        scores, ids, gold = triples[-1]
+        scores = scores.astype(jnp.float32)
+        lse = jax.nn.logsumexp(scores, axis=1)
+        final_cost = -(gold_score - lse)
+        cost = jnp.where(alive, final_cost, frozen_cost)
+        return cost[:, None]

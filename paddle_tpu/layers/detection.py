@@ -1,0 +1,116 @@
+"""SSD detection layers: priorbox, multibox_loss, detection_output.
+
+Reference: ``PriorBox.cpp``, ``MultiBoxLossLayer.cpp``,
+``DetectionOutputLayer.cpp`` (+ ``DetectionUtil.cpp``).  The math lives in
+:mod:`paddle_tpu.ops.detection_ops`; these layers adapt the config-driven
+input conventions:
+
+- ``priorbox``: inputs [feature, image]; geometry comes from attrs
+  (the DSL records the feature map and image dims at config time — the
+  reference reads them from Argument frame sizes at runtime).  Output is
+  the constant [1, P*8] prior tensor.
+- ``multibox_loss``: inputs [priorbox, label, loc..., conf...]
+  (``input_num`` loc layers then conf layers).  Labels are a padded
+  SequenceBatch [B, G, 6] (class,xmin,ymin,xmax,ymax,difficult).
+- ``detection_output``: inputs [priorbox, loc, conf] (the reference
+  concatenates multiple loc/conf inputs at config time via concat layers;
+  single concatenated inputs here).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.sequence import SequenceBatch, value_of
+from ..ops import detection_ops
+from ..utils import ConfigError, enforce
+from .base import Layer, register_layer
+
+
+def _priors_from_attrs(conf) -> np.ndarray:
+    a = conf.attrs
+    for k in ("layer_width", "layer_height", "image_width", "image_height"):
+        if a.get(k) is None:
+            raise ConfigError(f"priorbox layer: missing attr {k!r}")
+    return detection_ops.prior_boxes(
+        a["layer_height"], a["layer_width"],
+        a["image_height"], a["image_width"],
+        a.get("min_size", [1.0]), a.get("max_size", []),
+        a.get("aspect_ratio", []), a.get("variance", [0.1, 0.1, 0.2, 0.2]))
+
+
+@register_layer("priorbox")
+class PriorBoxLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        priors = _priors_from_attrs(self.conf)
+        return jnp.asarray(priors.reshape(1, -1))
+
+
+def _prior_tensor(v) -> jnp.ndarray:
+    """Priors are batch-independent; accept [1|B, P*8] or [P*8] and
+    return [P, 8]."""
+    v = value_of(v)
+    if v.ndim == 2:
+        v = v[0]
+    return v.reshape(-1, 8)
+
+
+def _as_loc(v) -> jnp.ndarray:
+    """[B, ...] conv output (NHWC or flat prior-major) -> [B, P, 4]."""
+    v = value_of(v)
+    return v.reshape(v.shape[0], -1, 4)
+
+
+def _as_conf(v, num_classes: int) -> jnp.ndarray:
+    v = value_of(v)
+    return v.reshape(v.shape[0], -1, num_classes)
+
+
+@register_layer("multibox_loss")
+class MultiBoxLossLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        a = self.conf.attrs
+        num_classes = a["num_classes"]
+        input_num = a.get("input_num", (len(inputs) - 2) // 2)
+        priors = _prior_tensor(inputs[0])
+        label = inputs[1]
+        enforce(isinstance(label, SequenceBatch),
+                "multibox_loss label must be a sequence of GT box rows")
+        locs = jnp.concatenate(
+            [_as_loc(v) for v in inputs[2:2 + input_num]], axis=1)
+        confs = jnp.concatenate(
+            [_as_conf(v, num_classes)
+             for v in inputs[2 + input_num:2 + 2 * input_num]], axis=1)
+        loss = detection_ops.multibox_loss(
+            confs, locs, priors, label.data, label.length,
+            num_classes=num_classes,
+            overlap_threshold=a.get("overlap_threshold", 0.5),
+            neg_overlap=a.get("neg_overlap", 0.5),
+            neg_pos_ratio=a.get("neg_pos_ratio", 3.0),
+            background_id=a.get("background_id", 0))
+        # CostLayer contract: per-sample cost column; the batch-summed SSD
+        # loss is already sample-normalized, so spread it evenly
+        b = value_of(inputs[2]).shape[0]
+        return jnp.full((b, 1), loss / b)
+
+
+@register_layer("detection_output")
+class DetectionOutputLayer(Layer):
+    def forward(self, params, inputs, ctx):
+        a = self.conf.attrs
+        num_classes = a["num_classes"]
+        input_num = a.get("input_num", 1)
+        priors = _prior_tensor(inputs[0])
+        locs = jnp.concatenate(
+            [_as_loc(v) for v in inputs[1:1 + input_num]], axis=1)
+        confs = jnp.concatenate(
+            [_as_conf(v, num_classes)
+             for v in inputs[1 + input_num:1 + 2 * input_num]], axis=1)
+        return detection_ops.detection_output(
+            confs, locs, priors, num_classes=num_classes,
+            background_id=a.get("background_id", 0),
+            conf_threshold=a.get("confidence_threshold", 0.01),
+            nms_top_k=a.get("nms_top_k", 400),
+            nms_threshold=a.get("nms_threshold", 0.45),
+            keep_top_k=a.get("keep_top_k", 200))

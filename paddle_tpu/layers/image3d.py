@@ -1,0 +1,126 @@
+"""3-D image layers: conv3d, deconv3d, pool3d.
+
+Reference: ``Conv3DLayer`` (``paddle/gserver/layers/Conv3DLayer.cpp``),
+``DeConv3DLayer`` (``DeConv3DLayer.cpp``), ``Pool3DLayer``
+(``Pool3DLayer.cpp``).  Geometry attrs mirror the 3-D extensions of
+``ConvConfig``/``PoolConfig`` (``filter_size_z``/``stride_z``/``padding_z``,
+``config_parser.py:908-966``).
+
+Layout is **NDHWC** internally (TPU lane-friendly); the reference's flat
+[B, C*D*H*W] rows are reshaped with CDHW order preserved, mirroring
+``to_nhwc`` in :mod:`.conv`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.sequence import like, value_of
+from ..ops import nn_ops
+from ..utils import ConfigError
+from .base import Layer, register_layer
+from .conv import conv_out_size
+
+
+def to_ndhwc(v, channels: int, depth: int, height: int, width: int):
+    """Accept [B, C*D*H*W] flat rows (reference layout) or already-NDHWC."""
+    if v.ndim == 2:
+        b = v.shape[0]
+        return jnp.moveaxis(v.reshape(b, channels, depth, height, width),
+                            1, -1)
+    if v.ndim == 5:
+        return v
+    raise ConfigError(f"cannot interpret 3-D image input of rank {v.ndim}")
+
+
+class _Img3DLayer(Layer):
+    def geo(self, key: str, default=None):
+        val = self.conf.attrs.get(key, default)
+        if val is None:
+            raise ConfigError(f"layer {self.name}: missing 3-D attr {key!r}")
+        return val
+
+    def _triple(self, key: str, default):
+        """(z, y, x) triple from attrs ``key_z``/``key_y``/``key``."""
+        base = self.conf.attrs.get(key, default)
+        return (self.conf.attrs.get(key + "_z", base),
+                self.conf.attrs.get(key + "_y", base),
+                base)
+
+    def _geometry(self):
+        c = self.geo("channels")
+        d = self.geo("img_size_z", self.conf.attrs.get("depth"))
+        h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+        w = self.geo("img_size")
+        return c, d, h, w
+
+
+@register_layer("conv3d")
+class Conv3DLayer(_Img3DLayer):
+    def param_specs(self):
+        c = self.geo("channels")
+        nf = self.geo("num_filters")
+        groups = self.conf.attrs.get("groups", 1)
+        fz, fy, fx = self._triple("filter_size", None)
+        specs = [self._weight_spec(0, (fz, fy, fx, c // groups, nf),
+                                   initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((nf,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        c, d, h, w = self._geometry()
+        x = to_ndhwc(value_of(inputs[0]), c, d, h, w)
+        stride = self._triple("stride", 1)
+        pad = self._triple("padding", 0)
+        out = nn_ops.conv3d(x, params[self.weight_name(0)], stride=stride,
+                            padding=[(p, p) for p in pad])
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("deconv3d")
+class DeConv3DLayer(_Img3DLayer):
+    def param_specs(self):
+        c = self.geo("channels")
+        nf = self.geo("num_filters")
+        fz, fy, fx = self._triple("filter_size", None)
+        specs = [self._weight_spec(0, (fz, fy, fx, nf, c),
+                                   initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((nf,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        c, d, h, w = self._geometry()
+        x = to_ndhwc(value_of(inputs[0]), c, d, h, w)
+        stride = self._triple("stride", 1)
+        pad = self._triple("padding", 0)
+        out = nn_ops.conv3d_transpose(
+            x, params[self.weight_name(0)], stride=stride,
+            padding=[(p, p) for p in pad])
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+@register_layer("pool3d")
+class Pool3DLayer(_Img3DLayer):
+    def forward(self, params, inputs, ctx):
+        c, d, h, w = self._geometry()
+        x = to_ndhwc(value_of(inputs[0]), c, d, h, w)
+        ptype = self.geo("pool_type", "max-projection")
+        kind = "max" if "max" in ptype else "avg"
+        window = self._triple("pool_size", 2)
+        stride = self._triple("stride", 2)
+        pad = self._triple("padding", 0)
+        out = nn_ops.pool3d(x, kind, window=window, stride=stride,
+                            padding=pad)
+        return self.finalize(like(inputs[0], out), ctx)
+
+
+def conv3d_out_shape(d, h, w, filt, pad, stride, caffe_mode=True):
+    """Output (D, H, W) for a z/y/x triple of filter/pad/stride."""
+    return tuple(conv_out_size(i, f, p, s, caffe_mode)
+                 for i, f, p, s in zip((d, h, w), filt, pad, stride))

@@ -26,6 +26,7 @@ from ..core.sequence import SequenceBatch, value_of
 from ..utils import ConfigError, enforce, global_stat, layer_stack
 from .base import LAYERS, ForwardContext, Layer, init_parameter
 from . import common, conv, cost, rnn, seq  # noqa: F401  (register layers)
+from . import detection, image3d  # noqa: F401  (register layers)
 from . import beam_search  # noqa: F401  (registers beam_gen)
 
 

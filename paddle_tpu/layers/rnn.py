@@ -17,7 +17,10 @@ import jax
 import jax.numpy as jnp
 
 from ..core.sequence import SequenceBatch, like, value_of
+import numpy as np
+
 from ..ops import recurrent_ops
+from ..ops import get_activation
 from ..ops.recurrent_ops import LstmState
 from ..utils import ConfigError, enforce
 from .base import ForwardContext, Layer, register_layer
@@ -157,3 +160,118 @@ class GruStepLayer(Layer):
             gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
             act=self.conf.active_type or "tanh")
         return like(inputs[0], out)
+
+
+@register_layer("mdlstmemory")
+class MDLstmLayer(Layer):
+    """2-D multi-dimensional LSTM (``MDLstmLayer.cpp``; Graves MD-LSTM).
+
+    Input: pre-projected gates over an H×W grid — dense [B, H*W*(3+nd)*D]
+    or SequenceBatch [B, H*W, (3+nd)*D] with nd=2 — gate column layout
+    [inode | ig | fg×nd | og] (``forwardGate2OutputSequence``).  Output is
+    the [B, H, W, D] hidden grid flattened to [B, H*W*D].
+
+    Parameters: recurrent weight [D, (3+nd)D] shared across dims
+    (``forwardOneSequence`` multiplies every predecessor by the same W);
+    bias [(5+2nd)D] = local gate bias (3+nd)D + peephole checks
+    checkIg(D) + checkFg(nd·D) + checkOg(D).
+
+    TPU mapping: ``lax.scan`` over rows carrying the previous row's
+    (h, c) [W, D], inner ``lax.scan`` over columns carrying (h, c) of the
+    left neighbour — the reference's CoordIterator grid walk with the
+    same data dependencies, vmapped over the batch.  Non-default
+    directions flip the grid before/after the scan.
+    """
+
+    ND = 2
+
+    def param_specs(self):
+        d = self.conf.size
+        nd = self.ND
+        specs = [self._weight_spec(0, (d, (3 + nd) * d), initial_smart=True)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec(((5 + 2 * nd) * d,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        d = self.conf.size
+        nd = self.ND
+        gw = (3 + nd) * d
+        height = self.conf.attrs.get("height")
+        width = self.conf.attrs.get("width")
+        v = value_of(inputs[0])
+        if v.ndim == 3:                      # SequenceBatch frames
+            b = v.shape[0]
+            enforce(height is not None and width is not None,
+                    "mdlstmemory on sequences needs height/width attrs")
+            x = v.reshape(b, height, width, gw)
+        else:
+            b = v.shape[0]
+            if height is None or width is None:
+                hw = v.shape[1] // gw
+                side = int(np.sqrt(hw))
+                enforce(side * side == hw,
+                        "mdlstmemory: supply height/width attrs for "
+                        "non-square grids")
+                height = width = side
+            x = v.reshape(b, height, width, gw)
+
+        w = params[self.weight_name(0)]
+        bias = params.get(self.bias_name()) if self.conf.with_bias else None
+        if bias is not None:
+            local = bias[:gw]
+            check_ig = bias[gw:gw + d]
+            check_fg = bias[gw + d:gw + d + nd * d].reshape(nd, d)
+            check_og = bias[gw + (1 + nd) * d:gw + (2 + nd) * d]
+        else:
+            local = jnp.zeros((gw,))
+            check_ig = check_og = jnp.zeros((d,))
+            check_fg = jnp.zeros((nd, d))
+
+        directions = self.conf.attrs.get("directions", [True, True])
+        gate_act = get_activation(
+            self.conf.attrs.get("active_gate_type", "sigmoid"))
+        state_act = get_activation(
+            self.conf.attrs.get("active_state_type", "tanh"))
+        node_act = get_activation(self.conf.active_type or "tanh")
+
+        # canonicalize walk order to top-left → bottom-right
+        flip_axes = [i + 1 for i, fwd in enumerate(directions) if not fwd]
+        if flip_axes:
+            x = jnp.flip(x, axis=flip_axes)
+
+        def cell(carry_left, xg_and_up):
+            h_left, c_left = carry_left
+            xg, h_up, c_up = xg_and_up
+            g = xg + local + h_up @ w + h_left @ w
+            inode = g[:d]
+            ig = g[d:2 * d] + c_up * check_ig + c_left * check_ig
+            fg0 = g[2 * d:3 * d] + c_up * check_fg[0]
+            fg1 = g[3 * d:4 * d] + c_left * check_fg[1]
+            og = g[4 * d:5 * d]
+            c = (gate_act(fg0) * c_up + gate_act(fg1) * c_left
+                 + node_act(inode) * gate_act(ig))
+            h = state_act(c) * gate_act(og + c * check_og)
+            return (h, c), (h, c)
+
+        def row_step(carry_row, x_row):
+            h_row, c_row = carry_row                     # [W, D] previous row
+            zero = jnp.zeros((d,), x_row.dtype)
+            (_, _), (hs, cs) = jax.lax.scan(
+                cell, (zero, zero), (x_row, h_row, c_row))
+            return (hs, cs), hs
+
+        def one_image(img):
+            init = (jnp.zeros((width, d), img.dtype),
+                    jnp.zeros((width, d), img.dtype))
+            _, h_grid = jax.lax.scan(row_step, init, img)
+            return h_grid                                # [H, W, D]
+
+        out = jax.vmap(one_image)(x)
+        if flip_axes:
+            out = jnp.flip(out, axis=flip_axes)
+        out = out.reshape(b, height * width * d)
+        if isinstance(inputs[0], SequenceBatch):
+            out = out.reshape(b, height * width, d)
+            return like(inputs[0], out)
+        return out

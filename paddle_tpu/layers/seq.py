@@ -221,3 +221,28 @@ class GatherAgentLayer(Layer):
 class ScatterAgentLayer(Layer):
     def forward(self, params, inputs, ctx):
         return inputs[0]
+
+
+@register_layer("row_conv")
+class RowConvLayer(Layer):
+    """Lookahead row convolution (``RowConvLayer.cpp``, DeepSpeech2):
+    ``out[t] = sum_{i<ctx} in[t+i] * W[i]`` per feature, within each
+    sequence.  W is [context_length, size]."""
+
+    def param_specs(self):
+        ctx_len = self.conf.attrs.get("context_length", 1)
+        return [self._weight_spec(0, (ctx_len, self.conf.size),
+                                  initial_smart=True)]
+
+    def forward(self, params, inputs, ctx):
+        seq = _as_seq(inputs[0])
+        w = params[self.weight_name(0)]
+        ctx_len = w.shape[0]
+        # zero out padding so lookahead past the sequence end contributes 0
+        x = seq.masked_data(0.0)  # [B, T, D]
+        out = jnp.zeros_like(x)
+        for i in range(ctx_len):
+            # shift left by i: x[:, t+i]; positions past T-i are zero
+            shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+            out = out + shifted * w[i]
+        return self.finalize(seq.with_data(out), ctx)

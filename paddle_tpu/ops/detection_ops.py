@@ -1,0 +1,299 @@
+"""SSD detection ops: prior boxes, IoU matching, multibox loss, NMS output.
+
+Reference semantics: ``paddle/gserver/layers/DetectionUtil.cpp``
+(``jaccardOverlap``, ``encodeBBoxWithVar:112``, ``decodeBBoxWithVar:137``,
+``matchBBox:234``, ``generateMatchIndices:329``, ``getDetectionIndices:466``,
+``getDetectionOutput:528``) and ``PriorBox.cpp`` / ``MultiBoxLossLayer.cpp``.
+
+TPU-first design: the reference runs all of this on the CPU with dynamic
+per-image loops; here everything is fixed-shape jax — ground-truth boxes
+arrive as a padded [B, G, 6] tensor with a validity count, matching is a
+static-length ``fori_loop`` bipartite pass + vectorized per-prediction pass,
+negative mining is a rank mask over sorted scores, and NMS keeps a fixed
+``keep_top_k`` with invalid slots marked (image index -1).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+# --------------------------------------------------------------- priors
+
+def prior_boxes(layer_h: int, layer_w: int, img_h: int, img_w: int,
+                min_sizes: Sequence[float],
+                max_sizes: Sequence[float],
+                aspect_ratios: Sequence[float],
+                variances: Sequence[float]) -> np.ndarray:
+    """[num_total_priors, 8] (4 clipped corners + 4 variances), row order
+    identical to ``PriorBoxLayer::forward`` (cell-major, then per cell:
+    min-size box, max-size box, non-unit aspect-ratio boxes)."""
+    ratios = [1.0]
+    for r in aspect_ratios:
+        ratios += [r, 1.0 / r]
+    step_w = img_w / layer_w
+    step_h = img_h / layer_h
+    rows: List[List[float]] = []
+
+    def emit(cx, cy, bw, bh):
+        rows.append([(cx - bw / 2.0) / img_w, (cy - bh / 2.0) / img_h,
+                     (cx + bw / 2.0) / img_w, (cy + bh / 2.0) / img_h]
+                    + list(variances))
+
+    for h in range(layer_h):
+        for w in range(layer_w):
+            cx = (w + 0.5) * step_w
+            cy = (h + 0.5) * step_h
+            for s, mn in enumerate(min_sizes):
+                emit(cx, cy, mn, mn)
+                if max_sizes:
+                    mx = math.sqrt(mn * max_sizes[s])
+                    emit(cx, cy, mx, mx)
+            mn = min_sizes[-1]
+            for r in ratios:
+                if abs(r - 1.0) < 1e-6:
+                    continue
+                emit(cx, cy, mn * math.sqrt(r), mn / math.sqrt(r))
+    out = np.asarray(rows, np.float32)
+    out[:, :4] = np.clip(out[:, :4], 0.0, 1.0)
+    return out
+
+
+def num_priors_per_cell(min_sizes, max_sizes, aspect_ratios) -> int:
+    n = 1 + 2 * len(aspect_ratios)
+    if max_sizes:
+        n += 1
+    return n
+
+
+# ------------------------------------------------------------- geometry
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Jaccard overlap between all pairs: a [P,4], b [G,4] -> [P,G]."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)   # [P,1]
+    bx1, by1, bx2, by2 = [v[None, :, 0] for v in jnp.split(b, 4, axis=-1)]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_form(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = (boxes[..., 0] + boxes[..., 2]) / 2.0
+    cy = (boxes[..., 1] + boxes[..., 3]) / 2.0
+    return cx, cy, w, h
+
+
+@register_op("encode_bbox")
+def encode_boxes(priors: jnp.ndarray, variances: jnp.ndarray,
+                 gt: jnp.ndarray) -> jnp.ndarray:
+    """``encodeBBoxWithVar``: [.,4] corner boxes -> variance-scaled offsets."""
+    pcx, pcy, pw, ph = _center_form(priors)
+    gcx, gcy, gw, gh = _center_form(gt)
+    pw = jnp.maximum(pw, 1e-8)
+    ph = jnp.maximum(ph, 1e-8)
+    return jnp.stack([
+        (gcx - pcx) / pw / variances[..., 0],
+        (gcy - pcy) / ph / variances[..., 1],
+        jnp.log(jnp.maximum(jnp.abs(gw / pw), 1e-8)) / variances[..., 2],
+        jnp.log(jnp.maximum(jnp.abs(gh / ph), 1e-8)) / variances[..., 3],
+    ], axis=-1)
+
+
+@register_op("decode_bbox")
+def decode_boxes(priors: jnp.ndarray, variances: jnp.ndarray,
+                 loc: jnp.ndarray) -> jnp.ndarray:
+    """``decodeBBoxWithVar``: offsets -> corner boxes."""
+    pcx, pcy, pw, ph = _center_form(priors)
+    cx = variances[..., 0] * loc[..., 0] * pw + pcx
+    cy = variances[..., 1] * loc[..., 1] * ph + pcy
+    w = jnp.exp(variances[..., 2] * loc[..., 2]) * pw
+    h = jnp.exp(variances[..., 3] * loc[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+# ------------------------------------------------------------- matching
+
+def match_priors(prior_corners: jnp.ndarray, gt_boxes: jnp.ndarray,
+                 gt_valid: jnp.ndarray, overlap_threshold: float
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``matchBBox``: bipartite pass (each GT claims its best prior) then
+    per-prediction pass (priors with IoU >= threshold claim their best GT).
+
+    prior_corners [P,4]; gt_boxes [G,4]; gt_valid [G] bool.
+    Returns (match_idx [P] int32, -1 = unmatched; match_overlap [P]).
+    """
+    P = prior_corners.shape[0]
+    G = gt_boxes.shape[0]
+    ov = iou_matrix(prior_corners, gt_boxes)          # [P,G]
+    ov = jnp.where(gt_valid[None, :], ov, 0.0)
+    match_overlap = jnp.max(ov, axis=1)
+    best_gt = jnp.argmax(ov, axis=1).astype(jnp.int32)
+
+    def bipartite_step(_, carry):
+        ovc, match = carry
+        flat = jnp.argmax(ovc)
+        p, g = flat // G, flat % G
+        valid = ovc[p, g] > 1e-6
+        match = jnp.where(valid, match.at[p].set(g.astype(jnp.int32)), match)
+        # retire the claimed prior row and GT column
+        ovc = jnp.where(valid, ovc.at[p, :].set(-1.0).at[:, g].set(-1.0), ovc)
+        return ovc, match
+
+    match = jnp.full((P,), -1, jnp.int32)
+    _, match = jax.lax.fori_loop(0, G, bipartite_step, (ov, match))
+    # per-prediction pass over the still-unmatched priors
+    take = (match < 0) & (match_overlap >= overlap_threshold)
+    match = jnp.where(take, best_gt, match)
+    return match, match_overlap
+
+
+@register_op("multibox_loss")
+def multibox_loss(conf: jnp.ndarray, loc: jnp.ndarray, priors: jnp.ndarray,
+                  gt: jnp.ndarray, gt_count: jnp.ndarray,
+                  num_classes: int, overlap_threshold: float = 0.5,
+                  neg_overlap: float = 0.5, neg_pos_ratio: float = 3.0,
+                  background_id: int = 0) -> jnp.ndarray:
+    """SSD loss (``MultiBoxLossLayer``): smooth-L1 on matched offsets +
+    softmax CE on matched positives and hard-mined negatives, both
+    normalized by the total match count across the batch.
+
+    conf [B,P,C]; loc [B,P,4]; priors [P,8]; gt [B,G,6]
+    (class,xmin,ymin,xmax,ymax,difficult) padded, gt_count [B].
+    """
+    B, P, C = conf.shape
+    G = gt.shape[1]
+    prior_corners = priors[:, :4]
+    prior_vars = priors[:, 4:]
+    gt_boxes = gt[..., 1:5]
+    gt_class = gt[..., 0].astype(jnp.int32)
+    gt_valid = jnp.arange(G)[None, :] < gt_count[:, None]       # [B,G]
+
+    match, match_ov = jax.vmap(
+        lambda g, v: match_priors(prior_corners, g, v, overlap_threshold)
+    )(gt_boxes, gt_valid)                                        # [B,P]
+    pos = match >= 0
+    num_pos = jnp.sum(pos)
+
+    # ---- location loss (smooth L1, only matched priors)
+    safe_match = jnp.maximum(match, 0)
+    gt_for_prior = jnp.take_along_axis(
+        gt_boxes, safe_match[..., None], axis=1)                 # [B,P,4]
+    target = encode_boxes(prior_corners[None], prior_vars[None], gt_for_prior)
+    diff = jnp.abs(loc.astype(jnp.float32) - target)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+    loc_loss = jnp.sum(jnp.where(pos[..., None], sl1, 0.0))
+
+    # ---- confidence loss
+    logits = conf.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    cls_for_prior = jnp.take_along_axis(gt_class, safe_match, axis=1)  # [B,P]
+    pos_ce = -jnp.take_along_axis(logp, cls_for_prior[..., None],
+                                  axis=-1)[..., 0]
+    neg_ce = -logp[..., background_id]
+
+    # hard negative mining: candidates are unmatched priors whose best
+    # overlap is below neg_overlap, ranked by max non-background score
+    probs = jax.nn.softmax(logits, axis=-1)
+    fg = probs.at[..., background_id].set(0.0) if C > 1 else probs
+    mine_score = jnp.max(fg, axis=-1)                            # [B,P]
+    cand = (~pos) & (match_ov < neg_overlap)
+    n_cand = jnp.sum(cand, axis=1)                               # [B]
+    n_pos_img = jnp.sum(pos, axis=1)
+    n_neg = jnp.minimum((neg_pos_ratio * n_pos_img).astype(jnp.int32), n_cand)
+    scores = jnp.where(cand, mine_score, -jnp.inf)
+    order = jnp.argsort(-scores, axis=1)
+    rank = jnp.argsort(order, axis=1)                            # rank per prior
+    neg = cand & (rank < n_neg[:, None])
+
+    conf_loss = (jnp.sum(jnp.where(pos, pos_ce, 0.0))
+                 + jnp.sum(jnp.where(neg, neg_ce, 0.0)))
+
+    denom = jnp.maximum(num_pos, 1).astype(jnp.float32)
+    total = (loc_loss + conf_loss) / denom
+    return jnp.where(num_pos > 0, total, 0.0)
+
+
+# ----------------------------------------------------------------- NMS
+
+def _nms_class(boxes: jnp.ndarray, scores: jnp.ndarray, top_k: int,
+               conf_threshold: float, nms_threshold: float
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``applyNMSFast`` for one class: returns (keep mask over top_k
+    candidates, candidate prior indices [top_k])."""
+    k = min(top_k, scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    cand_boxes = boxes[top_idx]                                  # [k,4]
+    ov = iou_matrix(cand_boxes, cand_boxes)                      # [k,k]
+
+    def body(i, keep):
+        # candidate i survives if above threshold and not overlapped by a
+        # surviving higher-scored candidate
+        sup = jnp.any(jnp.where(jnp.arange(k) < i,
+                                keep & (ov[i] > nms_threshold), False))
+        ok = (top_scores[i] > conf_threshold) & (~sup)
+        return keep.at[i].set(ok)
+
+    keep = jax.lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    return keep, top_idx
+
+
+@register_op("detection_output")
+def detection_output(conf: jnp.ndarray, loc: jnp.ndarray,
+                     priors: jnp.ndarray, num_classes: int,
+                     background_id: int = 0, conf_threshold: float = 0.01,
+                     nms_top_k: int = 400, nms_threshold: float = 0.45,
+                     keep_top_k: int = 200) -> jnp.ndarray:
+    """``DetectionOutputLayer``: decode + per-class NMS + global top-k.
+
+    Returns fixed-shape [B, keep_top_k, 7] rows
+    (image_idx, class, score, xmin, ymin, xmax, ymax); empty slots have
+    image_idx = -1.
+    """
+    B, P, C = conf.shape
+    probs = jax.nn.softmax(conf.astype(jnp.float32), axis=-1)
+
+    def per_image(n, probs_n, loc_n):
+        boxes = decode_boxes(priors[:, :4], priors[:, 4:], loc_n)  # [P,4]
+        all_scores, all_rows = [], []
+        for c in range(num_classes):
+            if c == background_id:
+                continue
+            keep, idx = _nms_class(boxes, probs_n[:, c], nms_top_k,
+                                   conf_threshold, nms_threshold)
+            sc = jnp.where(keep, probs_n[idx, c], -jnp.inf)
+            bx = jnp.clip(boxes[idx], 0.0, 1.0)
+            rows = jnp.concatenate([
+                jnp.full((idx.shape[0], 1), float(n)),
+                jnp.full((idx.shape[0], 1), float(c)),
+                sc[:, None], bx], axis=1)                         # [k,7]
+            all_scores.append(sc)
+            all_rows.append(rows)
+        scores = jnp.concatenate(all_scores)
+        rows = jnp.concatenate(all_rows, axis=0)
+        kk = min(keep_top_k, scores.shape[0])
+        top_sc, top_i = jax.lax.top_k(scores, kk)
+        out = rows[top_i]
+        out = jnp.where(jnp.isfinite(top_sc)[:, None], out,
+                        jnp.full_like(out, -1.0))
+        if kk < keep_top_k:
+            out = jnp.pad(out, ((0, keep_top_k - kk), (0, 0)),
+                          constant_values=-1.0)
+        return out
+
+    return jnp.stack([per_image(n, probs[n], loc[n]) for n in range(B)])

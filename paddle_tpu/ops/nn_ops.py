@@ -91,6 +91,43 @@ def conv3d(x, w, stride=1, padding="SAME", data_format: str = "NDHWC"):
         dimension_numbers=dn).astype(pol.output_dtype)
 
 
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, w, stride=1, padding="SAME",
+                     data_format: str = "NDHWC"):
+    """Transposed 3-D conv (``DeConv3DLayer``). x: [N,D,H,W,C];
+    w: [KD,KH,KW,Cout,Cin] (transpose_kernel layout, like conv2d_transpose)."""
+    pol = current_policy()
+    x = x.astype(pol.compute_dtype)
+    w = w.astype(pol.compute_dtype)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    out = lax.conv_transpose(
+        x, w, strides=s, padding=padding,
+        dimension_numbers=(data_format, "DHWIO", data_format),
+        transpose_kernel=True)
+    return out.astype(pol.output_dtype)
+
+
+@register_op("pool3d")
+def pool3d(x, pool_type: str = "max", window=2, stride=2, padding=0):
+    """3-D max/avg pool over NDHWC (``Pool3DLayer``); avg excludes padding
+    from the divisor like ``_pool``."""
+    kd, kh, kw = (window,) * 3 if isinstance(window, int) else tuple(window)
+    sd, sh, sw = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    pd, ph, pw = padding
+    dims, strides = (1, kd, kh, kw, 1), (1, sd, sh, sw, 1)
+    pads = [(0, 0), (pd, pd), (ph, ph), (pw, pw), (0, 0)]
+    if "max" in pool_type:
+        return lax.reduce_window(x, -np.inf, lax.max, dims, strides, pads)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims,
+                               strides, pads)
+    return summed / counts
+
+
 def _pool(x, kind: str, window: IntOr2, stride: IntOr2, padding,
           data_format: str = "NHWC"):
     kh, kw = _pair(window)
