@@ -57,7 +57,7 @@ def _feeder_for(provider, model):
 def cmd_train(args) -> int:
     from .config.config_parser import parse_config
     from .layers.network import NeuralNetwork
-    from .trainer.trainer import Trainer
+    from .parallel.local_sgd import make_trainer
 
     model, opt, ds = parse_config(args.config, args.config_args)
     log.info("config parsed: %d layers, batch_size=%d, method=%s",
@@ -67,7 +67,8 @@ def cmd_train(args) -> int:
     if cfg_dir not in sys.path:
         sys.path.insert(0, cfg_dir)
     net = NeuralNetwork(model)
-    trainer = Trainer(net, opt_config=opt)
+    # honors OptimizationConfig.local_sgd_steps (async/local-SGD mode)
+    trainer = make_trainer(net, opt)
     # restore parameters BEFORE any job runs (test must see them)
     if args.init_model_path:
         trainer.load(args.init_model_path)
@@ -127,6 +128,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     tp.add_argument("--mesh_shape", default="",
                     help="e.g. data=4,model=2 (replaces --trainer_count)")
     tp.add_argument("--use_bf16", type=int, default=None)
+    tp.add_argument("--bf16_activations", type=int, default=None)
     tp.set_defaults(fn=cmd_train)
 
     vp = sub.add_parser("version", help="print build info")
@@ -137,6 +139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         FLAGS.set("mesh_shape", args.mesh_shape)
     if getattr(args, "use_bf16", None) is not None:
         FLAGS.set("use_bf16", bool(args.use_bf16))
+    if getattr(args, "bf16_activations", None) is not None:
+        FLAGS.set("bf16_activations", bool(args.bf16_activations))
     return args.fn(args)
 
 

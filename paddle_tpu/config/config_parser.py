@@ -136,8 +136,10 @@ def settings(batch_size: int = 32, learning_rate: float = 0.01,
              learning_rate_decay_b: float = 0.0,
              learning_rate_schedule: str = "constant",
              average_window: float = 0.0,
-             max_average_window: int = 0, **_ignored) -> None:
+             max_average_window: int = 0,
+             local_sgd_steps: int = 0, **_ignored) -> None:
     oc = _state.opt
+    oc.local_sgd_steps = local_sgd_steps
     oc.batch_size = batch_size
     oc.learning_rate = learning_rate
     oc.gradient_clipping_threshold = gradient_clipping_threshold

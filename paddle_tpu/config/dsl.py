@@ -394,25 +394,60 @@ def context_projection(input: LayerOutput, context_len: int,
             padding_attr if trainable else None)
 
 
+@dataclass
+class Operator:
+    """A mixed-layer operator (``conv_operator``/``dotmul_operator``):
+    parameter-free, reads other layers' VALUES (``Operator.h``)."""
+
+    kind: str
+    op_inputs: List["LayerOutput"]
+    attrs: Dict[str, Any]
+    output_size: int = 0
+
+
 def mixed(input=None, size: int = 0, name: Optional[str] = None, act=None,
-          bias_attr=False, layer_attr=None) -> LayerOutput:
-    """``mixed_layer``: input is a list of projection tuples."""
-    projs = _as_list(input)
+          bias_attr=False, layer_attr=None, operators=None) -> LayerOutput:
+    """``mixed_layer``: input is a list of projection tuples; operators
+    are :class:`Operator` objects appended as extra (projection-less)
+    inputs."""
+    items = _as_list(input)
     ins, pcs, pas = [], [], []
-    for item in projs:
+    op_list = []
+    for item in items:
+        if isinstance(item, Operator):
+            op_list.append(item)
+            continue
         li, pc, pa = item
         ins.append(li)
         pcs.append(pc)
         pas.append(pa)
+    op_list.extend(_as_list(operators))
+    op_attrs = []
+    for op in op_list:
+        idx = []
+        for li in op.op_inputs:
+            ins.append(li)
+            pcs.append(None)
+            pas.append(None)
+            idx.append(len(ins) - 1)
+        op_attrs.append({**op.attrs, "type": op.kind,
+                         "input_indices": tuple(idx)})
+        if size == 0 and op.output_size:
+            size = op.output_size
     if size == 0:
         for pc in pcs:
-            if pc.output_size:
+            if pc is not None and pc.output_size:
                 size = pc.output_size
                 break
         else:
+            enforce(pcs and pcs[0] is not None,
+                    "mixed layer needs a size, a sized projection, or an "
+                    "operator with a known output size")
             size = pcs[0].context_length * pcs[0].input_size
+    attrs = {"operators": op_attrs} if op_attrs else None
     return _add_layer(name, "mixed", size, _mk_inputs(ins, pas, pcs), act,
-                      bias_attr, layer_attr=layer_attr, param_attrs=pas)
+                      bias_attr, attrs=attrs, layer_attr=layer_attr,
+                      param_attrs=pas)
 
 
 mixed_layer = mixed
@@ -1276,3 +1311,571 @@ def config_scope():
         yield _collector
     finally:
         _collector = old
+
+
+# ---------------------------------------------------- v1 DSL parity layer
+# The remaining ``trainer_config_helpers/layers.py`` ``__all__`` surface:
+# thin wrappers over already-registered engine layer types (reference
+# signatures kept; tests/test_dsl_parity.py asserts 1:1 name coverage).
+
+
+class AggregateLevel:
+    """``AggregateLevel`` (layers.py:275)."""
+
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # deprecated reference spellings
+    EACH_TIMESTEP = "non-seq"
+    EACH_SEQUENCE = "seq"
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = AggregateLevel.TO_NO_SEQUENCE
+
+
+class LayerType:
+    """Layer type-string constants (``layers.py LayerType``) — the subset
+    configs actually reference, mapped to this engine's registered names."""
+
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    CONV_LAYER = "exconv"
+    CONVTRANS_LAYER = "exconvt"
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    NORM_LAYER = "norm"
+    COST = "cost"
+    CRF_LAYER = "crf"
+    CTC_LAYER = "ctc"
+
+    @staticmethod
+    def is_layer_type(type_name: str) -> bool:
+        from ..layers import LAYERS
+        return type_name in LAYERS
+
+
+def layer_support(*attrs):
+    """Reference decorator marking ExtraLayerAttribute support — the TPU
+    engine accepts ExtraAttr uniformly, so this is a no-op passthrough."""
+
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+@dataclass
+class SubsequenceInput:
+    """Marks a nested-sequence in-link of a recurrent group
+    (``SubsequenceInput``): the group steps over subsequences.  The TPU
+    group dispatches on the runtime NestedSequenceBatch type, so this is
+    StepInput with intent documented."""
+
+    layer: LayerOutput
+
+
+class BaseGeneratedInput:
+    """Base marker class (``layers.py BaseGeneratedInput``)."""
+
+
+# ---- projections / operators
+
+
+def trans_full_matrix_projection(input: LayerOutput, size: int = 0,
+                                 param_attr: Optional[ParamAttr] = None):
+    """``TransposedFullMatrixProjection``: y = x W^T with W [size, in]."""
+    return (input, ProjConfig(type="trans_fc", input_size=input.size,
+                              output_size=size), param_attr)
+
+
+def slice_projection(input: LayerOutput, slices):
+    """``SliceProjection``: concatenate [begin, end) column ranges."""
+    slices = [tuple(s) for s in slices]
+    for b, e in slices:
+        enforce(0 <= b < e <= input.size,
+                f"slice ({b}, {e}) out of range for input size {input.size}")
+    return (input, ProjConfig(type="slice", input_size=input.size,
+                              output_size=sum(e - b for b, e in slices),
+                              slices=slices), None)
+
+
+def dotmul_operator(a: LayerOutput = None, b: LayerOutput = None,
+                    scale: float = 1.0, **kwargs) -> Operator:
+    """``DotMulOperator``: elementwise a*b*scale inside a mixed layer."""
+    a = a or kwargs.get("x")
+    b = b or kwargs.get("y")
+    enforce(a is not None and b is not None, "dotmul_operator needs a and b")
+    return Operator(kind="dot_mul", op_inputs=[a, b],
+                    attrs={"scale": scale}, output_size=a.size)
+
+
+def conv_operator(img: LayerOutput, filter: LayerOutput, filter_size: int,
+                  num_filters: int, num_channels: Optional[int] = None,
+                  stride: int = 1, padding: int = 0,
+                  filter_size_y: Optional[int] = None,
+                  stride_y: Optional[int] = None,
+                  padding_y: Optional[int] = None,
+                  trans: bool = False) -> Operator:
+    """``ConvOperator``: convolution whose per-sample filter comes from
+    another layer's output (``ConvOperator.cpp``)."""
+    enforce(not trans, "conv_operator: transposed conv operators are not "
+            "supported (no reference config uses ConvTransOperator via "
+            "the v1 DSL)")
+    c = num_channels or getattr(img, "channels", 1)
+    isz = getattr(img, "img_size", int(round((img.size / c) ** 0.5)))
+    isz_y = getattr(img, "img_size_y", isz)
+    fy = filter_size_y or filter_size
+    sy = stride_y or stride
+    py = padding_y if padding_y is not None else padding
+    out_x = conv_out(isz, filter_size, padding, stride)
+    out_y = conv_out(isz_y, fy, py, sy)
+    return Operator(
+        kind="conv", op_inputs=[img, filter],
+        attrs={"channels": c, "img_size": isz, "img_size_y": isz_y,
+               "filter_size": filter_size, "filter_size_y": fy,
+               "num_filters": num_filters, "stride": stride, "stride_y": sy,
+               "padding": padding, "padding_y": py},
+        output_size=num_filters * out_x * out_y)
+
+
+# ---- shape / image glue layers
+
+
+def repeat_layer(input: LayerOutput, num_repeats: int,
+                 as_row_vector: bool = True, act=None,
+                 name: Optional[str] = None, layer_attr=None) -> LayerOutput:
+    """``RepeatLayer`` (type featmap_expand): tile features num_repeats×."""
+    inp = _as_list(input)[0]
+    attrs = {"num_filters": num_repeats, "as_row_vector": as_row_vector}
+    return _add_layer(name, "featmap_expand", inp.size * num_repeats,
+                      _mk_inputs([inp]), act, False, attrs, layer_attr)
+
+
+def rotate_layer(input: LayerOutput, height: int, width: int,
+                 name: Optional[str] = None, layer_attr=None) -> LayerOutput:
+    """``RotateLayer``: 90° CCW rotation of [H, W] feature matrices."""
+    inp = _as_list(input)[0]
+    return _add_layer(name, "rotate", inp.size, _mk_inputs([inp]), None,
+                      False, {"height": height, "width": width}, layer_attr)
+
+
+def resize_layer(input: LayerOutput, size: int,
+                 name: Optional[str] = None) -> LayerOutput:
+    """``ResizeLayer``: reshape the batch to rows of ``size``."""
+    inp = _as_list(input)[0]
+    return _add_layer(name, "resize", size, _mk_inputs([inp]), None, False)
+
+
+def pad_layer(input: LayerOutput, pad_c=None, pad_h=None, pad_w=None,
+              name: Optional[str] = None, layer_attr=None) -> LayerOutput:
+    """``PadLayer``: zero-pad along channel/height/width."""
+    inp = _as_list(input)[0]
+    pad_c = list(pad_c or [0, 0])
+    pad_h = list(pad_h or [0, 0])
+    pad_w = list(pad_w or [0, 0])
+    c = getattr(inp, "channels", 1)
+    h = getattr(inp, "img_size_y", getattr(inp, "img_size", None))
+    w = getattr(inp, "img_size", None)
+    if w is None:
+        w = h = int(round((inp.size / c) ** 0.5))
+    oc, oh, ow = c + sum(pad_c), h + sum(pad_h), w + sum(pad_w)
+    attrs = {"channels": c, "img_size": w, "img_size_y": h,
+             "pad_c": pad_c, "pad_h": pad_h, "pad_w": pad_w}
+    out = _add_layer(name, "pad", oc * oh * ow, _mk_inputs([inp]), None,
+                     False, attrs, layer_attr)
+    out.channels, out.img_size, out.img_size_y = oc, ow, oh
+    return out
+
+
+def crop_layer(input, offset, axis: int = 2, shape=None,
+               name: Optional[str] = None, layer_attr=None) -> LayerOutput:
+    """``CropLayer``: crop [H, W] windows (axis=2 → spatial crop, the only
+    mode the reference demos use)."""
+    inp = _as_list(input)[0]
+    enforce(axis == 2 and shape is not None,
+            "crop_layer: only spatial (axis=2) cropping with an explicit "
+            "shape is supported")
+    c = getattr(inp, "channels", 1)
+    h = getattr(inp, "img_size_y", getattr(inp, "img_size", None))
+    w = getattr(inp, "img_size", None)
+    if w is None:
+        w = h = int(round((inp.size / c) ** 0.5))
+    ch, cw = shape[-2], shape[-1]
+    attrs = {"channels": c, "img_size": w, "img_size_y": h,
+             "crop_offsets": list(offset), "crop_shape": [ch, cw]}
+    out = _add_layer(name, "crop", c * ch * cw, _mk_inputs([inp]), None,
+                     False, attrs, layer_attr)
+    out.channels, out.img_size, out.img_size_y = c, cw, ch
+    return out
+
+
+def switch_order_layer(input: LayerOutput, name: Optional[str] = None,
+                       reshape_axis: Optional[int] = None, act=None,
+                       layer_attr=None) -> LayerOutput:
+    """``SwitchOrderLayer``: NCHW ↔ NHWC reorder (reshape_axis=3 ↔
+    channels-last, the reference's only used mode)."""
+    inp = _as_list(input)[0]
+    to = "NHWC" if (reshape_axis or 3) == 3 else "NCHW"
+    attrs = {"to": to, "channels": getattr(inp, "channels", 1),
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    return _add_layer(name, "switch_order", inp.size, _mk_inputs([inp]),
+                      act, False, attrs, layer_attr)
+
+
+def block_expand_layer(input: LayerOutput, block_x: int = 0, block_y: int = 0,
+                       stride_x: int = 0, stride_y: int = 0,
+                       padding_x: int = 0, padding_y: int = 0,
+                       num_channels: Optional[int] = None,
+                       name: Optional[str] = None,
+                       layer_attr=None) -> LayerOutput:
+    """``BlockExpandLayer``: im2col into a sequence of flattened blocks
+    (OCR models; output is a sequence over block positions)."""
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    attrs = {"channels": c, "block_x": block_x, "block_y": block_y,
+             "stride_x": stride_x, "stride_y": stride_y,
+             "padding_x": padding_x, "padding_y": padding_y,
+             "img_size": getattr(inp, "img_size", None),
+             "img_size_y": getattr(inp, "img_size_y", None)}
+    return _add_layer(name, "blockexpand", c * block_x * block_y,
+                      _mk_inputs([inp]), None, False, attrs, layer_attr)
+
+
+# ---- dense / misc layers
+
+
+def tensor_layer(a: LayerOutput, b: LayerOutput, size: int, act=None,
+                 name: Optional[str] = None,
+                 param_attr: Optional[ParamAttr] = None, bias_attr=True,
+                 layer_attr=None) -> LayerOutput:
+    """``TensorLayer``: out_k = a W_k b^T."""
+    pas = [param_attr, None] if param_attr else None  # one weight, on input 0
+    return _add_layer(name, "tensor", size, _mk_inputs([a, b], pas), act,
+                      bias_attr, layer_attr=layer_attr, param_attrs=pas)
+
+
+def selective_fc_layer(input, size: int, select: Optional[LayerOutput] = None,
+                       act=None, name: Optional[str] = None,
+                       pass_generation: bool = False,
+                       has_selected_colums: bool = True,
+                       mul_ratio: float = 0.02,
+                       param_attr: Optional[ParamAttr] = None,
+                       bias_attr=True, layer_attr=None) -> LayerOutput:
+    """``SelectiveFullyConnectedLayer``: fc evaluated only on selected
+    output columns."""
+    ins = _as_list(input)
+    if select is not None:
+        ins = ins + [select]
+    pas = [param_attr] * len(ins) if param_attr else None
+    return _add_layer(name, "selective_fc", size, _mk_inputs(ins, pas), act,
+                      bias_attr, layer_attr=layer_attr, param_attrs=pas)
+
+
+def linear_comb_layer(weights: LayerOutput, vectors: LayerOutput,
+                      size: Optional[int] = None, name: Optional[str] = None,
+                      layer_attr=None) -> LayerOutput:
+    """``ConvexCombinationLayer`` (type convex_comb): out = w · reshaped
+    vectors."""
+    size = size or vectors.size // max(weights.size, 1)
+    return _add_layer(name, "convex_comb", size,
+                      _mk_inputs([weights, vectors]), None, False,
+                      layer_attr=layer_attr)
+
+
+def conv_shift_layer(a: LayerOutput, b: LayerOutput,
+                     name: Optional[str] = None, layer_attr=None
+                     ) -> LayerOutput:
+    """``ConvShiftLayer``: circular convolution (NTM addressing); b's
+    width must be odd."""
+    enforce(b.size % 2 == 1, "conv_shift: filter width must be odd")
+    return _add_layer(name, "conv_shift", a.size, _mk_inputs([a, b]), None,
+                      False, layer_attr=layer_attr)
+
+
+def row_conv_layer(input: LayerOutput, context_len: int, act=None,
+                   name: Optional[str] = None,
+                   param_attr: Optional[ParamAttr] = None,
+                   layer_attr=None) -> LayerOutput:
+    """``RowConvLayer``: lookahead convolution (DeepSpeech2)."""
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "row_conv", input.size, _mk_inputs([input], pas),
+                      act, False, {"context_length": context_len},
+                      layer_attr, pas)
+
+
+def gated_unit_layer(input: LayerOutput, size: int, act=None,
+                     name: Optional[str] = None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=True,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=True, layer_attr=None) -> LayerOutput:
+    """Gated linear unit (``gated_unit_layer``, Dauphin et al.): a
+    composite of two fc layers joined by a dotmul operator — the
+    reference builds the identical three-layer graph."""
+    name = name or _collector.unique_name("gated_unit")
+    proj = fc(input, size, act=act, name=f"{name}_input_proj",
+              param_attr=inproj_param_attr, bias_attr=inproj_bias_attr,
+              layer_attr=inproj_attr)
+    gate = fc(input, size, act=SigmoidActivation(), name=f"{name}_gate",
+              param_attr=gate_param_attr, bias_attr=gate_bias_attr,
+              layer_attr=gate_attr)
+    return mixed(operators=[dotmul_operator(proj, gate)], size=size,
+                 name=name, layer_attr=layer_attr)
+
+
+def print_layer(input, format: Optional[str] = None,
+                name: Optional[str] = None) -> LayerOutput:
+    """``PrintLayer``: host-side debug print of layer values."""
+    ins = _as_list(input)
+    return _add_layer(name, "print", ins[0].size, _mk_inputs(ins), None,
+                      False, {"format": format})
+
+
+printer_layer = print_layer
+
+
+def get_output_layer(input: LayerOutput, arg_name: str,
+                     name: Optional[str] = None, layer_attr=None
+                     ) -> LayerOutput:
+    """``GetOutputLayer``: select a named extra output (e.g. lstm ``state``)
+    of a layer — addressed here as the dotted value ``layer.arg_name``."""
+    src = input.name if arg_name in ("", "out") else f"{input.name}.{arg_name}"
+    return _add_layer(name, "get_output", input.size,
+                      [LayerInput(input_layer_name=src)], None, False,
+                      layer_attr=layer_attr)
+
+
+def gru_step_naive_layer(input: LayerOutput, output_mem: LayerOutput,
+                         size: Optional[int] = None,
+                         name: Optional[str] = None, act=None,
+                         gate_act=None, bias_attr=None, param_attr=None,
+                         layer_attr=None) -> LayerOutput:
+    """``gru_step_naive_layer``: the reference re-derives the GRU step from
+    primitive layers (identical math to the fused ``gru_step``); here both
+    names drive the same fused TPU step kernel."""
+    return gru_step_layer(input, output_mem, size=size, name=name, act=act,
+                          gate_act=gate_act, bias_attr=bias_attr,
+                          param_attr=param_attr, layer_attr=layer_attr)
+
+
+def sub_nested_seq_layer(input: LayerOutput, selected_indices: LayerOutput,
+                         name: Optional[str] = None) -> LayerOutput:
+    """``SubNestedSequenceLayer``: select subsequences by index."""
+    return _add_layer(name, "sub_nested_seq", input.size,
+                      _mk_inputs([input, selected_indices]), None, False)
+
+
+kmax_seq_score_layer = kmax_sequence_score_layer
+
+
+# ---- SSD detection layers
+
+
+def priorbox_layer(input: LayerOutput, image: LayerOutput, aspect_ratio,
+                   variance, min_size, max_size=[],
+                   name: Optional[str] = None) -> LayerOutput:
+    """``PriorBoxLayer`` (SSD): generate prior boxes over the feature map
+    grid of ``input`` relative to ``image`` dimensions."""
+    from ..ops.detection_ops import num_priors_per_cell
+
+    c = getattr(input, "channels", 1)
+    lw = getattr(input, "img_size", int(round((input.size / c) ** 0.5)))
+    lh = getattr(input, "img_size_y", lw)
+    img_conf = _collector.by_name.get(image.name)
+    iw = ih = None
+    if img_conf is not None:
+        iw = img_conf.attrs.get("width") or None
+        ih = img_conf.attrs.get("height") or None
+    if not iw:
+        ic = getattr(image, "channels", 3)
+        iw = ih = int(round((image.size / ic) ** 0.5))
+    n = lh * lw * num_priors_per_cell(min_size, max_size, aspect_ratio)
+    attrs = {"layer_width": lw, "layer_height": lh,
+             "image_width": iw, "image_height": ih,
+             "min_size": list(min_size), "max_size": list(max_size),
+             "aspect_ratio": list(aspect_ratio), "variance": list(variance)}
+    return _add_layer(name, "priorbox", n * 8, _mk_inputs([input, image]),
+                      None, False, attrs)
+
+
+def cross_channel_norm_layer(input: LayerOutput, name: Optional[str] = None,
+                             param_attr: Optional[ParamAttr] = None
+                             ) -> LayerOutput:
+    """``CrossChannelNormLayer`` (SSD conv4_3 L2 norm with learned scale)."""
+    c = getattr(input, "channels", 1)
+    pas = [param_attr] if param_attr else None
+    out = _add_layer(name, "cross-channel-norm", input.size,
+                     _mk_inputs([input], pas), None, False,
+                     {"channels": c}, param_attrs=pas)
+    for a in ("channels", "img_size", "img_size_y"):
+        if hasattr(input, a):
+            setattr(out, a, getattr(input, a))
+    return out
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox: LayerOutput,
+                        label: LayerOutput, num_classes: int,
+                        overlap_threshold: float = 0.5,
+                        neg_pos_ratio: float = 3.0,
+                        neg_overlap: float = 0.5, background_id: int = 0,
+                        name: Optional[str] = None) -> LayerOutput:
+    """``MultiBoxLossLayer`` (SSD training loss)."""
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    enforce(len(locs) == len(confs),
+            "multibox_loss: need matching loc/conf input lists")
+    attrs = {"num_classes": num_classes, "input_num": len(locs),
+             "overlap_threshold": overlap_threshold,
+             "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+             "background_id": background_id}
+    return _add_layer(name, "multibox_loss", 1,
+                      _mk_inputs([priorbox, label] + locs + confs), None,
+                      False, attrs)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox: LayerOutput,
+                           num_classes: int, nms_threshold: float = 0.45,
+                           nms_top_k: int = 400, keep_top_k: int = 200,
+                           confidence_threshold: float = 0.01,
+                           background_id: int = 0,
+                           name: Optional[str] = None) -> LayerOutput:
+    """``DetectionOutputLayer`` (SSD inference: decode + NMS)."""
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    enforce(len(locs) == len(confs),
+            "detection_output: need matching loc/conf input lists")
+    attrs = {"num_classes": num_classes, "input_num": len(locs),
+             "nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
+             "keep_top_k": keep_top_k,
+             "confidence_threshold": confidence_threshold,
+             "background_id": background_id}
+    return _add_layer(name, "detection_output", keep_top_k * 7,
+                      _mk_inputs([priorbox] + locs + confs), None, False,
+                      attrs)
+
+
+# ---- 3-D image layers
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def img_conv3d_layer(input: LayerOutput, filter_size, num_filters: int,
+                     name: Optional[str] = None,
+                     num_channels: Optional[int] = None, act=None,
+                     groups: int = 1, stride=1, padding=0, bias_attr=None,
+                     param_attr: Optional[ParamAttr] = None,
+                     shared_biases: bool = True, layer_attr=None,
+                     trans: bool = False,
+                     layer_type: Optional[str] = None) -> LayerOutput:
+    """``Conv3DLayer``/``DeConv3DLayer`` over NDHWC volumes."""
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    fz, fy, fx = _triple(filter_size)
+    sz, sy, sx = _triple(stride)
+    pz, py, px = _triple(padding)
+    d = getattr(inp, "img_size_z", None)
+    h = getattr(inp, "img_size_y", None)
+    w = getattr(inp, "img_size", None)
+    if w is None:
+        side = int(round((inp.size / c) ** (1.0 / 3.0)))
+        d = h = w = side
+    from ..layers.image3d import conv3d_out_shape
+
+    od, oh, ow = conv3d_out_shape(d, h, w, (fz, fy, fx), (pz, py, px),
+                                  (sz, sy, sx))
+    attrs = {"channels": c, "num_filters": num_filters, "groups": groups,
+             "filter_size": fx, "filter_size_y": fy, "filter_size_z": fz,
+             "stride": sx, "stride_y": sy, "stride_z": sz,
+             "padding": px, "padding_y": py, "padding_z": pz,
+             "img_size": w, "img_size_y": h, "img_size_z": d}
+    pas = [param_attr] if param_attr else None
+    ltype = layer_type or ("deconv3d" if trans else "conv3d")
+    out = _add_layer(name, ltype, num_filters * od * oh * ow,
+                     _mk_inputs([inp], pas), act,
+                     True if bias_attr is None else bias_attr, attrs,
+                     layer_attr, pas)
+    out.channels = num_filters
+    out.img_size, out.img_size_y, out.img_size_z = ow, oh, od
+    return out
+
+
+def img_pool3d_layer(input: LayerOutput, pool_size,
+                     name: Optional[str] = None,
+                     num_channels: Optional[int] = None, pool_type=None,
+                     stride=2, padding=0, layer_attr=None,
+                     pool_size_y=None, stride_y=None, padding_y=None,
+                     pool_size_z=None, stride_z=None, padding_z=None,
+                     ceil_mode: bool = True) -> LayerOutput:
+    """``Pool3DLayer`` over NDHWC volumes."""
+    inp = _as_list(input)[0]
+    c = num_channels or getattr(inp, "channels", 1)
+    kx = pool_size if isinstance(pool_size, int) else pool_size[-1]
+    ky = pool_size_y or kx
+    kz = pool_size_z or kx
+    sx = stride if isinstance(stride, int) else stride[-1]
+    sy = stride_y or sx
+    sz = stride_z or sx
+    px = padding if isinstance(padding, int) else padding[-1]
+    py = padding_y if padding_y is not None else px
+    pz = padding_z if padding_z is not None else px
+    d = getattr(inp, "img_size_z", None)
+    h = getattr(inp, "img_size_y", None)
+    w = getattr(inp, "img_size", None)
+    if w is None:
+        side = int(round((inp.size / c) ** (1.0 / 3.0)))
+        d = h = w = side
+    from ..layers.image3d import conv3d_out_shape
+
+    od, oh, ow = conv3d_out_shape(d, h, w, (kz, ky, kx), (pz, py, px),
+                                  (sz, sy, sx), caffe_mode=not ceil_mode)
+    attrs = {"channels": c, "pool_type": (pool_type or MaxPooling()).name,
+             "pool_size": kx, "pool_size_y": ky, "pool_size_z": kz,
+             "stride": sx, "stride_y": sy, "stride_z": sz,
+             "padding": px, "padding_y": py, "padding_z": pz,
+             "img_size": w, "img_size_y": h, "img_size_z": d}
+    out = _add_layer(name, "pool3d", c * od * oh * ow, _mk_inputs([inp]),
+                     None, False, attrs, layer_attr)
+    out.channels = c
+    out.img_size, out.img_size_y, out.img_size_z = ow, oh, od
+    return out
+
+
+# ---- beam cost
+
+
+@dataclass
+class BeamInput:
+    """One beam-expansion triple for :func:`cross_entropy_over_beam`
+    (``layers.py:6014``)."""
+
+    candidate_scores: LayerOutput
+    selected_candidates: LayerOutput
+    gold: LayerOutput
+
+
+def cross_entropy_over_beam(input, name: Optional[str] = None) -> LayerOutput:
+    """``cross_entropy_over_beam`` (globally-normalized beam CE,
+    ``CrossEntropyOverBeam.cpp``): input is a list of BeamInput triples."""
+    beams = _as_list(input)
+    ins: List[LayerOutput] = []
+    for bi in beams:
+        ins.extend([bi.candidate_scores, bi.selected_candidates, bi.gold])
+    return _add_layer(name, "cross_entropy_over_beam", 1, _mk_inputs(ins),
+                      None, False)
+
+
+# ---- cost-name aliases (reference __all__ spellings)
+
+cross_entropy_with_selfnorm = cross_entropy_with_selfnorm_cost
+multi_binary_label_cross_entropy = multi_binary_label_cross_entropy_cost

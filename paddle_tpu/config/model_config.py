@@ -190,6 +190,13 @@ class OptimizationConfig:
     max_average_window: int = 0
     num_batches_per_send_parameter: int = 1
     num_batches_per_get_parameter: int = 1
+    # Async-SGD re-expression (ParameterServer2.h:468 lock-free async
+    # apply; doOperation AVERAGE_PARAMETER, ParameterService.proto:24-110):
+    # each data-parallel shard applies K local optimizer steps without
+    # gradient synchronization, then parameters are averaged across the
+    # mesh.  0 = synchronous all-reduce DP (default).  K=1 with plain SGD
+    # is numerically identical to sync DP (tests/test_local_sgd.py).
+    local_sgd_steps: int = 0
 
 
 @dataclass

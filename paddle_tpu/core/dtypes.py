@@ -41,6 +41,11 @@ class Policy:
 
 _f32 = Policy(jnp.float32, jnp.float32, jnp.float32)
 _bf16 = Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+# Full-bf16 activations: layer outputs stay bf16, halving activation HBM
+# traffic (the usual TPU bottleneck).  Params and losses remain fp32;
+# numerically-sensitive ops (softmax, log, batch-norm stats) compute in
+# fp32 internally regardless.  Enabled with --bf16_activations.
+_bf16_act = Policy(jnp.float32, jnp.bfloat16, jnp.bfloat16)
 
 _override: list = []
 
@@ -48,7 +53,9 @@ _override: list = []
 def current_policy() -> Policy:
     if _override:
         return _override[-1]
-    return _bf16 if FLAGS.use_bf16 else _f32
+    if not FLAGS.use_bf16:
+        return _f32
+    return _bf16_act if FLAGS.bf16_activations else _bf16
 
 
 @contextlib.contextmanager

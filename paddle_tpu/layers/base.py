@@ -116,6 +116,34 @@ class Layer:
         return self.apply_dropout(self.apply_activation(out), ctx)
 
 
+def cast_layer_output(layer: "Layer", out: Any) -> Any:
+    """Normalize a layer's float outputs to the policy output dtype.
+
+    Under ``--bf16_activations`` this is what keeps the whole graph's
+    activations bf16: any layer that promoted to fp32 (e.g. by adding an
+    fp32 bias) is cast back at the engine boundary, so scan carries stay
+    dtype-stable and activation HBM traffic is halved.  Cost layers are
+    exempt (losses accumulate fp32).
+    """
+    from ..core.dtypes import current_policy
+
+    odt = current_policy().output_dtype
+    if odt == jnp.float32 or getattr(layer, "is_cost", False):
+        return out
+
+    def cast(v):
+        data = value_of(v)
+        if hasattr(data, "astype") and hasattr(data, "dtype") \
+                and jnp.issubdtype(data.dtype, jnp.floating) \
+                and data.dtype != odt:
+            return like(v, data.astype(odt))
+        return v
+
+    if isinstance(out, dict):
+        return {k: cast(v) for k, v in out.items()}
+    return cast(out)
+
+
 def init_parameter(key: jax.Array, spec: ParameterConfig) -> jax.Array:
     """Initialize one parameter per ``ParameterConfig`` semantics
     (initial_strategy/mean/std/smart — ``paddle/parameter/Parameter.cpp``)."""

@@ -114,6 +114,17 @@ class EmbeddingLayer(Layer):
         return self.finalize(like(inputs[0], out), ctx)
 
 
+def _add_flat_bias(out: jax.Array, bias: jax.Array) -> jax.Array:
+    """Add a per-element bias stored in the reference's flat CHW order to
+    an output that may be in NHWC image layout (googlenet's inception
+    ``concat_layer(bias_attr=True)`` owns a bias of size C*H*W)."""
+    if out.ndim == 4 and bias.ndim == 1 \
+            and bias.size == out.shape[1] * out.shape[2] * out.shape[3]:
+        b, h, w, c = out.shape
+        bias = jnp.moveaxis(bias.reshape(c, h, w), 0, -1)
+    return out + bias
+
+
 @register_layer("addto")
 class AddtoLayer(Layer):
     def forward(self, params, inputs, ctx):
@@ -121,7 +132,7 @@ class AddtoLayer(Layer):
         for x in inputs[1:]:
             out = out + value_of(x)
         if self.conf.with_bias:
-            out = out + params[self.bias_name()]
+            out = _add_flat_bias(out, params[self.bias_name()])
         return self.finalize(like(inputs[0], out), ctx)
 
     def param_specs(self):
@@ -134,7 +145,7 @@ class ConcatLayer(Layer):
         vals = [value_of(x) for x in inputs]
         out = jnp.concatenate(vals, axis=-1)
         if self.conf.with_bias:   # googlenet inception: concat+bias+relu
-            out = out + params[self.bias_name()]
+            out = _add_flat_bias(out, params[self.bias_name()])
         return self.finalize(like(inputs[0], out), ctx)
 
     def param_specs(self):
@@ -150,12 +161,6 @@ class MixedLayer(Layer):
     scaling, table, context, slice; operator 'dot_mul_operator' over two
     inputs via attrs.
     """
-
-    def _proj(self, i):
-        p = self.conf.inputs[i].proj
-        if p is None:
-            raise ConfigError(f"mixed layer {self.name} input {i} has no projection")
-        return p
 
     def param_specs(self):
         specs = []
@@ -193,7 +198,9 @@ class MixedLayer(Layer):
         out = None
         template = inputs[0]
         for i, x in enumerate(inputs):
-            p = self._proj(i)
+            p = self.conf.inputs[i].proj
+            if p is None:       # operator input — consumed by the
+                continue        # operators loop below
             v = value_of(x)
             if p.type == "fc":
                 y = _flat_apply(lambda t: math_ops.matmul(t, params[self.weight_name(i)]), x)
@@ -252,13 +259,24 @@ class MixedLayer(Layer):
             fw = op["filter_size"]
             nf = op["num_filters"]
             x = to_nhwc(a, c, h, w)
-            # the filter comes from a layer's VALUE (shared across the
-            # batch), not a parameter — ConvOperator semantics
-            filt = b.reshape(-1)[: fh * fw * c * nf]
-            filt = filt.reshape(nf, c, fh, fw).transpose(2, 3, 1, 0)
-            y = nn_ops.conv2d(x, filt, stride=op.get("stride", 1),
-                              padding=[(op.get("padding", 0),) * 2] * 2)
-            y = y.reshape(y.shape[0], -1)
+            # the filter comes from a layer's VALUE with one filter PER
+            # SAMPLE (ConvOperator.cpp:61 requires ins_[1] height ==
+            # batchSize; :72 offsets wgtData by weightOffset_*batchId) —
+            # vmap a conv over the batch so each sample uses its own filter
+            filt = b.reshape(b.shape[0], nf, c, fh, fw) \
+                    .transpose(0, 3, 4, 2, 1)           # [B, fh, fw, c, nf]
+            stride = (op.get("stride_y", op.get("stride", 1)),
+                      op.get("stride", 1))
+            padding = [(op.get("padding_y", op.get("padding", 0)),) * 2,
+                       (op.get("padding", 0),) * 2]
+
+            def conv_one(xi, fi):
+                return nn_ops.conv2d(xi[None], fi, stride=stride,
+                                     padding=padding)[0]
+
+            y = jax.vmap(conv_one)(x, filt)             # [B, H', W', nf]
+            # flat rows are channel-major (CHW) like every image layer here
+            y = jnp.moveaxis(y, -1, 1).reshape(y.shape[0], -1)
         else:
             raise ConfigError(f"unknown mixed operator {kind!r}")
         return y if out is None else out + y

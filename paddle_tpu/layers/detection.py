@@ -89,10 +89,12 @@ class MultiBoxLossLayer(Layer):
             neg_overlap=a.get("neg_overlap", 0.5),
             neg_pos_ratio=a.get("neg_pos_ratio", 3.0),
             background_id=a.get("background_id", 0))
-        # CostLayer contract: per-sample cost column; the batch-summed SSD
-        # loss is already sample-normalized, so spread it evenly
+        # MultiBoxLossLayer.cpp assigns the full (already numMatches-
+        # normalized) loss to every output row; NeuralNetwork.loss then
+        # sums rows / batchSize, recovering exactly `loss` — same
+        # objective and gradient scale as the reference
         b = value_of(inputs[2]).shape[0]
-        return jnp.full((b, 1), loss / b)
+        return jnp.full((b, 1), loss)
 
 
 @register_layer("detection_output")

@@ -24,7 +24,13 @@ import numpy as np
 from ..config.model_config import LayerConfig, ModelConfig, ParameterConfig
 from ..core.sequence import SequenceBatch, value_of
 from ..utils import ConfigError, enforce, global_stat, layer_stack
-from .base import LAYERS, ForwardContext, Layer, init_parameter
+from .base import (
+    LAYERS,
+    ForwardContext,
+    Layer,
+    cast_layer_output,
+    init_parameter,
+)
 from . import common, conv, cost, rnn, seq  # noqa: F401  (register layers)
 from . import detection, image3d  # noqa: F401  (register layers)
 from . import beam_search  # noqa: F401  (registers beam_gen)
@@ -155,7 +161,7 @@ class NeuralNetwork:
                     if iname not in values:
                         self._run_producer(iname, params, values, ctx, done_groups)
                     inputs.append(values[iname])
-                out = layer.forward(params, inputs, ctx)
+                out = cast_layer_output(layer, layer.forward(params, inputs, ctx))
             if isinstance(out, dict):
                 for k, v in out.items():
                     values[name if k == "out" else f"{name}.{k}"] = v

@@ -24,11 +24,15 @@ import jax.numpy as jnp
 from ..config.model_config import ModelConfig, SubModelConfig
 from ..core.sequence import NestedSequenceBatch, SequenceBatch, value_of
 from ..utils import ConfigError, enforce, layer_stack
-from .base import LAYERS, ForwardContext, Layer
+from .base import LAYERS, ForwardContext, Layer, cast_layer_output
 
 
 class RecurrentGroup:
     """Executes one SubModelConfig with lax.scan."""
+
+    # Epilogue hoisting (see :meth:`_split_scan_epilogue`); class attr so
+    # tests can compare hoisted vs in-scan execution.
+    HOIST = True
 
     def __init__(self, sub: SubModelConfig, model: ModelConfig):
         self.sub = sub
@@ -46,11 +50,49 @@ class RecurrentGroup:
         self.out_links = list(sub.out_links)
         self.memories = list(sub.memories)
 
+    # ------------------------------------------------- epilogue hoisting
+    def _producer_of(self, iname: str) -> Optional[str]:
+        """Group-layer that produces value name ``iname`` (handles the
+        ``layer.subkey`` convention for dict outputs), else None."""
+        if iname in self.layers:
+            return iname
+        if "." in iname:
+            head = iname.split(".", 1)[0]
+            if head in self.layers:
+                return head
+        return None
+
+    def _split_scan_epilogue(self) -> Tuple[set, List[str]]:
+        """Split the step layers into (scan set, hoisted suffix).
+
+        A layer must run inside the scan iff a memory depends on it
+        (transitively).  Everything else is time-pointwise — its frame-t
+        output never feeds frame t+1 — so it can run AFTER the scan,
+        vmapped over the stacked time axis.  XLA then batches the hoisted
+        matmuls over T*B instead of issuing T sequential ones; for
+        decoder output projections ([B,H]×[H,V] per step, V≫H) this is
+        the difference between MXU-bound and latency-bound.  This is an
+        optimization the reference's step-by-step
+        ``RecurrentGradientMachine.cpp`` cannot express.
+        """
+        need = {m["layer_name"] for m in self.memories}
+        changed = True
+        while changed:
+            changed = False
+            for n in list(need):
+                for iname in self.layers[n].conf.input_names():
+                    p = self._producer_of(iname)
+                    if p is not None and p not in need:
+                        need.add(p)
+                        changed = True
+        hoisted = [n for n in self.order if n not in need]
+        return need, hoisted
+
     def _memory_init(self, mem: Dict[str, Any], values: Dict[str, Any],
                      batch: int, dtype) -> jax.Array:
         boot = mem.get("boot_layer_name")
         if boot:
-            return value_of(values[boot])
+            return value_of(values[boot]).astype(dtype)
         size = mem.get("size", 0)
         if not size:
             size = self.model.find_layer(mem["layer_name"]).size
@@ -60,14 +102,11 @@ class RecurrentGroup:
             init = init + bias
         return init
 
-    def step(self, params: Dict[str, jax.Array], frame: Dict[str, Any],
-             mems: List[jax.Array], outer: Dict[str, Any],
-             ctx: ForwardContext) -> Tuple[List[jax.Array], Dict[str, Any]]:
-        """One timestep: returns (new memory values, all step outputs)."""
-        values: Dict[str, Any] = dict(frame)
-        for mem, mval in zip(self.memories, mems):
-            values[mem.get("link_name", mem["layer_name"] + "@pre")] = mval
-        for name in self.order:
+    def _forward_layers(self, names: List[str], values: Dict[str, Any],
+                        outer: Dict[str, Any], params: Dict[str, jax.Array],
+                        ctx: ForwardContext) -> None:
+        """Run ``names`` (already topo-ordered) in place over ``values``."""
+        for name in names:
             layer = self.layers[name]
             with layer_stack.guard(name + "@" + self.sub.name):
                 inputs = []
@@ -79,12 +118,24 @@ class RecurrentGroup:
                     else:
                         raise ConfigError(
                             f"group {self.sub.name}: input {iname!r} not found")
-                out = layer.forward(params, inputs, ctx)
+                out = cast_layer_output(layer, layer.forward(params, inputs, ctx))
             if isinstance(out, dict):
                 for k, v in out.items():
                     values[name if k == "out" else f"{name}.{k}"] = v
             else:
                 values[name] = out
+
+    def step(self, params: Dict[str, jax.Array], frame: Dict[str, Any],
+             mems: List[jax.Array], outer: Dict[str, Any],
+             ctx: ForwardContext,
+             order: Optional[List[str]] = None
+             ) -> Tuple[List[jax.Array], Dict[str, Any]]:
+        """One timestep: returns (new memory values, all step outputs)."""
+        values: Dict[str, Any] = dict(frame)
+        for mem, mval in zip(self.memories, mems):
+            values[mem.get("link_name", mem["layer_name"] + "@pre")] = mval
+        self._forward_layers(self.order if order is None else order,
+                             values, outer, params, ctx)
         new_mems = [value_of(values[m["layer_name"]]) for m in self.memories]
         return new_mems, values
 
@@ -101,13 +152,19 @@ class RecurrentGroup:
             enforce(isinstance(s, SequenceBatch),
                     f"in_link {l!r} must be a sequence")
             seqs.append(s)
+        from ..core.dtypes import current_policy
+
         t = seqs[0].max_len
         b = seqs[0].batch_size
         length = seqs[0].length
-        mask = seqs[0].mask(jnp.float32)  # [B, T]
+        # carries/mask in the policy output dtype: under
+        # --bf16_activations the whole scan body runs bf16 (layer outputs
+        # are bf16), so a fp32 carry would destabilize the scan dtype
+        fdt = current_policy().output_dtype
+        mask = seqs[0].mask(fdt)  # [B, T]
         dtype = seqs[0].data.dtype
 
-        mems0 = [self._memory_init(m, values, b, jnp.float32)
+        mems0 = [self._memory_init(m, values, b, fdt)
                  for m in self.memories]
 
         # scanned inputs: [T, B, ...]
@@ -119,25 +176,80 @@ class RecurrentGroup:
 
         outer = values
 
+        scan_set, hoisted = (self._split_scan_epilogue() if self.HOIST
+                             else (set(self.order), []))
+        hoist_set = set(hoisted)
+        # hoisted layers that (transitively) feed a hoisted out-link;
+        # the rest are dead past the scan and are dropped entirely
+        hoist_outs = [o for o in self.out_links if o in hoist_set]
+        live = set(hoist_outs)
+        for n in reversed(hoisted):
+            if n in live:
+                for iname in self.layers[n].conf.input_names():
+                    p = self._producer_of(iname)
+                    if p is not None and p in hoist_set:
+                        live.add(p)
+        hoisted = [n for n in hoisted if n in live]
+        hoist_set = set(hoisted)
+        # values the epilogue reads out of the scan: in-scan layer
+        # outputs (incl. dict sub-outputs) and memory pre-values
+        mem_links = {m.get("link_name", m["layer_name"] + "@pre")
+                     for m in self.memories}
+        boundary: set = set()
+        frames_used: set = set()
+        for n in hoisted:
+            for iname in self.layers[n].conf.input_names():
+                p = self._producer_of(iname)
+                if p is not None and p in scan_set:
+                    boundary.add(iname)
+                elif iname in mem_links:
+                    boundary.add(iname)
+                elif iname in self.in_links:
+                    frames_used.add(iname)
+
+        scan_order = [n for n in self.order if n in scan_set]
+        scan_outs = [o for o in self.out_links if o not in hoist_set]
+
         def scan_fn(carry, inp):
             mems = carry
             frame_inputs = {l: inp[l] for l in self.in_links}
             m = inp["__mask__"][:, None]
             new_mems, step_vals = self.step(params, frame_inputs, mems,
-                                            outer, ctx)
+                                            outer, ctx, order=scan_order)
             kept = [m * nm + (1 - m) * om for nm, om in zip(new_mems, mems)]
             outs = {}
-            for o in self.out_links:
+            for o in scan_outs:
                 d = value_of(step_vals[o])
                 mb = (m > 0).reshape((b,) + (1,) * (d.ndim - 1))
                 # where, not multiply: keeps integer out-links (maxid,
                 # sampling ids) in their own dtype
                 outs[o] = jnp.where(mb, d, jnp.zeros((), d.dtype))
+            for bname in boundary:
+                outs["__b__" + bname] = value_of(step_vals[bname])
             return kept, outs
 
         inp = dict(xs)
         inp["__mask__"] = m_t
         _, stacked = jax.lax.scan(scan_fn, mems0, inp)
+
+        if hoisted:
+            # run the time-pointwise suffix over the whole stacked time
+            # axis at once: vmap over T batches the per-step matmuls into
+            # single MXU-sized ones (decoder softmax projections etc.)
+            def epilogue(frame):
+                vals = dict(frame)
+                self._forward_layers(hoisted, vals, outer, params, ctx)
+                return {o: value_of(vals[o]) for o in hoist_outs}
+
+            epi_in = {bname: stacked["__b__" + bname] for bname in boundary}
+            for l in frames_used:
+                epi_in[l] = xs[l]
+            epi_stacked = jax.vmap(epilogue)(epi_in)
+            for o in hoist_outs:
+                d = epi_stacked[o]
+                mb = (m_t > 0).reshape(m_t.shape + (1,) * (d.ndim - 2))
+                stacked[o] = jnp.where(mb, d, jnp.zeros((), d.dtype))
+
         for o in self.out_links:
             data = jnp.moveaxis(stacked[o], 0, 1)  # [B, T, ...]
             if self.sub.reversed:
@@ -162,11 +274,14 @@ class RecurrentGroup:
                     f"in_link {l!r}: all in-links of a nested group must "
                     "be nested sequences")
             seqs.append(s)
+        from ..core.dtypes import current_policy
+
         b = seqs[0].batch_size
         num_subseq = seqs[0].num_subseq
-        outer_mask = seqs[0].subseq_mask(jnp.float32)        # [B, S]
+        fdt = current_policy().output_dtype
+        outer_mask = seqs[0].subseq_mask(fdt)                # [B, S]
 
-        mems0 = [self._memory_init(m, values, b, jnp.float32)
+        mems0 = [self._memory_init(m, values, b, fdt)
                  for m in self.memories]
 
         # scanned inputs: SequenceBatch pytrees with leading S axis

@@ -109,34 +109,37 @@ class RecurrentLayer(Layer):
 
 @register_layer("lstm_step")
 class LstmStepLayer(Layer):
-    """Single LSTM step for recurrent groups (``LstmStepLayer``).
+    """Single LSTM step for recurrent groups (``LstmStepLayer.cpp``).
 
-    Inputs: [0] projected gates [B, 4H]; [1] prev state c [B, H] (as the
-    second output convention).  Output: h; cell state exposed via attrs.
+    Reference contract (init: ``CHECK_EQ(2U, inputLayers_.size())``):
+    inputs [0] gates [B, 4H] — already containing EVERY contribution,
+    recurrent included (no weight matrix on this layer) — and [1] the
+    previous cell state c [B, H].  The 3H bias parameter holds the
+    peephole checks (checkIg/checkFg/checkOg, ``:83-101``).
+    Outputs: h, with the new cell exposed as ``.state``.
     """
 
     def param_specs(self):
         h = self.conf.size
-        specs = [self._weight_spec(0, (h, 4 * h), initial_smart=True)]
         if self.conf.with_bias:
-            specs.append(self._bias_spec((7 * h,)))
-        return specs
+            return [self._bias_spec((3 * h,))]
+        return []
 
     def forward(self, params, inputs, ctx):
         x = value_of(inputs[0])
-        h_prev = value_of(inputs[1])
-        c_prev = value_of(inputs[2])
+        c_prev = value_of(inputs[1])
         h = self.conf.size
-        bias = params.get(self.bias_name()) if self.conf.with_bias else None
-        gb = ci = cf = co = None
-        if bias is not None:
-            gb, ci, cf, co = (bias[:4 * h], bias[4 * h:5 * h],
-                              bias[5 * h:6 * h], bias[6 * h:7 * h])
-            x = x + gb
+        checks = params.get(self.bias_name()) if self.conf.with_bias else None
+        ci = cf = co = None
+        if checks is not None:
+            ci, cf, co = checks[:h], checks[h:2 * h], checks[2 * h:3 * h]
         state, out = recurrent_ops.lstm_gate_step(
-            x, LstmState(h=h_prev, c=c_prev), params[self.weight_name(0)],
-            ci, cf, co)
-        # expose (h, c); network stores tuple outputs by name suffix
+            x, LstmState(h=jnp.zeros_like(c_prev), c=c_prev), None,
+            ci, cf, co,
+            gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
+            cell_act=self.conf.attrs.get("active_state_type", "tanh"),
+            out_act=self.conf.active_type or "tanh")
+        # expose (h, c); network stores dict outputs by name suffix
         return {"out": like(inputs[0], out), "state": like(inputs[0], state.c)}
 
 

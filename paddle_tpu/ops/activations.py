@@ -162,7 +162,8 @@ def linear(x):
 
 @_act("softmax")
 def softmax(x, axis: int = -1):
-    return jax.nn.softmax(x, axis=axis)
+    # fp32 internally: bf16 exp/normalize loses probability mass
+    return jax.nn.softmax(x.astype(jnp.float32), axis=axis).astype(x.dtype)
 
 
 @_act("sequence_softmax")

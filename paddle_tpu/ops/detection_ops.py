@@ -51,11 +51,15 @@ def prior_boxes(layer_h: int, layer_w: int, img_h: int, img_w: int,
         for w in range(layer_w):
             cx = (w + 0.5) * step_w
             cy = (h + 0.5) * step_h
-            for s, mn in enumerate(min_sizes):
+            for mn in min_sizes:
                 emit(cx, cy, mn, mn)
-                if max_sizes:
-                    mx = math.sqrt(mn * max_sizes[s])
-                    emit(cx, cy, mx, mx)
+                # PriorBox.cpp:119 nests the FULL max-size loop inside
+                # each min-size iteration (quirk kept for row-order and
+                # weight compatibility): every (min, max) pair emits a
+                # sqrt(min*max) box
+                for mx in max_sizes:
+                    s = math.sqrt(mn * mx)
+                    emit(cx, cy, s, s)
             mn = min_sizes[-1]
             for r in ratios:
                 if abs(r - 1.0) < 1e-6:
@@ -67,10 +71,8 @@ def prior_boxes(layer_h: int, layer_w: int, img_h: int, img_w: int,
 
 
 def num_priors_per_cell(min_sizes, max_sizes, aspect_ratios) -> int:
-    n = 1 + 2 * len(aspect_ratios)
-    if max_sizes:
-        n += 1
-    return n
+    return (len(min_sizes) * (1 + len(max_sizes))
+            + 2 * len(aspect_ratios))
 
 
 # ------------------------------------------------------------- geometry

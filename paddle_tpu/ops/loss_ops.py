@@ -30,7 +30,7 @@ def cross_entropy(p, label, soft_label: bool = False, eps: float = 1e-8):
 
     p: [B, C] probabilities; label: [B] int ids or [B, C] soft labels.
     """
-    logp = jnp.log(jnp.clip(p, eps, 1.0))
+    logp = jnp.log(jnp.clip(p.astype(jnp.float32), eps, 1.0))
     if soft_label:
         return -jnp.sum(label * logp, axis=-1)
     return -jnp.take_along_axis(logp, label.reshape(-1, 1).astype(jnp.int32), axis=-1)[:, 0]

@@ -224,14 +224,19 @@ def batch_norm(x, scale, bias, running_mean, running_var,
                is_training: bool = True, data_format: str = "NHWC"):
     """Batch normalization (``batch_norm_op.cc``, ``BatchNormalizationLayer``).
 
-    Returns (y, new_running_mean, new_running_var).  Stats are computed in
-    fp32 regardless of compute dtype (TPU numerics).
+    Returns (y, new_running_mean, new_running_var).  Stats accumulate in
+    fp32 regardless of compute dtype (TPU numerics), but the tensor is
+    READ in its own dtype (one pass, E[x²]−E[x]² with fp32 accumulators)
+    and the normalization is a single multiply-add in x's dtype with the
+    per-channel scale/offset folded — under bf16 activations this halves
+    BN's HBM traffic, which dominates ResNet-class steps (measured: BN at
+    ~1/3 of the fp32-pass train step).
     """
     axes = tuple(i for i in range(x.ndim) if i != (x.ndim - 1 if data_format.endswith("C") else 1))
-    xf = x.astype(jnp.float32)
     if is_training:
-        m = jnp.mean(xf, axis=axes)
-        v = jnp.var(xf, axis=axes)
+        m = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        m2 = jnp.mean(x * x, axis=axes, dtype=jnp.float32)
+        v = jnp.maximum(m2 - m * m, 0.0)
         new_rm = momentum * running_mean + (1 - momentum) * m
         new_rv = momentum * running_var + (1 - momentum) * v
     else:
@@ -240,9 +245,10 @@ def batch_norm(x, scale, bias, running_mean, running_var,
     shape = [1] * x.ndim
     c_ax = x.ndim - 1 if data_format.endswith("C") else 1
     shape[c_ax] = x.shape[c_ax]
-    inv = lax.rsqrt(v + eps).reshape(shape)
-    y = (xf - m.reshape(shape)) * inv * scale.reshape(shape) + bias.reshape(shape)
-    return y.astype(x.dtype), new_rm, new_rv
+    inv = lax.rsqrt(v + eps)
+    a = (inv * scale).astype(x.dtype).reshape(shape)
+    b = (bias - m * inv * scale).astype(x.dtype).reshape(shape)
+    return x * a + b, new_rm, new_rv
 
 
 @register_op("lrn")

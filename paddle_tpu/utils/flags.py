@@ -113,6 +113,9 @@ FLAGS.define("start_pass", 0, "first pass number (resume)")
 FLAGS.define("save_dir", "./output", "checkpoint output dir")
 FLAGS.define("config_args", "", "comma-sep k=v pairs visible to configs")
 FLAGS.define("use_bf16", True, "run matmul/conv compute in bfloat16 on TPU")
+FLAGS.define("bf16_activations", False,
+             "store layer activations in bfloat16 (halves activation HBM "
+             "traffic; params/losses stay fp32)")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

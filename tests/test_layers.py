@@ -288,3 +288,64 @@ def test_shared_parameters():
     params = net.init_params()
     assert "shared_w" in params
     assert len([k for k in params if "w" in k]) == 1
+
+
+def test_recurrent_group_epilogue_hoist_equivalence(rng):
+    """The epilogue-hoist optimization (layers past the recurrence run
+    vmapped AFTER the scan) must be invisible: outputs and grads match
+    in-scan execution bit-for-bit on the same topology."""
+    from paddle_tpu.layers.recurrent_group import RecurrentGroup
+
+    d, h, v = 3, 4, 6
+    layers = [
+        LayerConfig(name="x", type="data", size=d),
+        LayerConfig(name="rec", type="fc", size=h, active_type="tanh",
+                    inputs=[LayerInput(input_layer_name="x"),
+                            LayerInput(input_layer_name="h_pre")]),
+        # hoistable suffix: proj (reads rec) -> out (reads proj and the
+        # in-link frame x) — neither feeds the memory
+        LayerConfig(name="proj", type="fc", size=v, active_type="softmax",
+                    inputs=[LayerInput(input_layer_name="rec")]),
+        LayerConfig(name="out", type="fc", size=v,
+                    inputs=[LayerInput(input_layer_name="proj"),
+                            LayerInput(input_layer_name="x")]),
+        LayerConfig(name="pool", type="seqlastins", size=v,
+                    inputs=[LayerInput(input_layer_name="out")]),
+    ]
+    sub = SubModelConfig(
+        name="g", layer_names=["x", "rec", "proj", "out"], in_links=["x"],
+        out_links=["out"],
+        memories=[{"layer_name": "rec", "link_name": "h_pre", "size": h}])
+    net = NeuralNetwork(ModelConfig(
+        layers=layers, sub_models=[SubModelConfig(name="root"), sub],
+        output_layer_names=["pool"]))
+    params = net.init_params()
+    feed = {"x": _seq(rng, [5, 3], d)}
+
+    # structural check: rec stays in scan, proj/out hoist
+    rg = RecurrentGroup(sub, net.config)
+    scan_set, hoisted = rg._split_scan_epilogue()
+    assert scan_set == {"rec"}
+    assert hoisted == ["proj", "out"]
+
+    def run():
+        values, _ = net.forward(params, feed)
+
+        def loss(p):
+            vals, _ = net.forward(p, feed)
+            return jnp.sum(vals["pool"] ** 2)
+
+        grads = jax.grad(loss)(params)
+        return np.asarray(values["out"].data), grads
+
+    try:
+        RecurrentGroup.HOIST = False
+        out_ref, g_ref = run()
+    finally:
+        RecurrentGroup.HOIST = True
+    out_opt, g_opt = run()
+    np.testing.assert_allclose(out_opt, out_ref, rtol=1e-6, atol=1e-6)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_opt[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
