@@ -75,7 +75,16 @@ class RecurrentGroup:
         optimization the reference's step-by-step
         ``RecurrentGradientMachine.cpp`` cannot express.
         """
-        need = {m["layer_name"] for m in self.memories}
+        # memories may bind a dict sub-output ("lstm_out.state"): seed
+        # with the PRODUCER layer, not the raw value name
+        need = set()
+        for m in self.memories:
+            p = self._producer_of(m["layer_name"])
+            if p is None:
+                raise ConfigError(
+                    f"group {self.sub.name}: memory layer "
+                    f"{m['layer_name']!r} is not produced by the group")
+            need.add(p)
         changed = True
         while changed:
             changed = False
@@ -95,7 +104,9 @@ class RecurrentGroup:
             return value_of(values[boot]).astype(dtype)
         size = mem.get("size", 0)
         if not size:
-            size = self.model.find_layer(mem["layer_name"]).size
+            # dotted memory names ("lstm_out.state") size like their head
+            size = self.model.find_layer(
+                mem["layer_name"].split(".", 1)[0]).size
         init = jnp.zeros((batch, size), dtype)
         bias = mem.get("boot_bias")
         if bias is not None:
@@ -179,10 +190,15 @@ class RecurrentGroup:
         scan_set, hoisted = (self._split_scan_epilogue() if self.HOIST
                              else (set(self.order), []))
         hoist_set = set(hoisted)
+        # classify out-links by PRODUCER (an out-link can be a dict
+        # sub-output like "lstm_out.state")
+        hoist_outs = [o for o in self.out_links
+                      if (self._producer_of(o) or o) in hoist_set]
         # hoisted layers that (transitively) feed a hoisted out-link;
-        # the rest are dead past the scan and are dropped entirely
-        hoist_outs = [o for o in self.out_links if o in hoist_set]
-        live = set(hoist_outs)
+        # the rest are dead past the scan and are dropped entirely —
+        # except side-effect layers (print), which must still run
+        live = {self._producer_of(o) or o for o in hoist_outs}
+        live |= {n for n in hoisted if self.layers[n].conf.type == "print"}
         for n in reversed(hoisted):
             if n in live:
                 for iname in self.layers[n].conf.input_names():
@@ -208,7 +224,7 @@ class RecurrentGroup:
                     frames_used.add(iname)
 
         scan_order = [n for n in self.order if n in scan_set]
-        scan_outs = [o for o in self.out_links if o not in hoist_set]
+        scan_outs = [o for o in self.out_links if o not in set(hoist_outs)]
 
         def scan_fn(carry, inp):
             mems = carry

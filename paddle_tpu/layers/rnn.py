@@ -53,12 +53,14 @@ class LstmLayer(Layer):
             check_i = bias[4 * h: 5 * h]
             check_f = bias[5 * h: 6 * h]
             check_o = bias[6 * h: 7 * h]
+        # reference routing (hl_lstm_ops.cuh:60,65): active_type acts on
+        # the candidate input, active_state_type on the cell output
         out, _ = recurrent_ops.lstm_sequence(
             seq, None, w_hh, gate_bias, check_i, check_f, check_o,
             reverse=self.conf.attrs.get("reversed", False),
             gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
-            cell_act=self.conf.attrs.get("active_state_type", "tanh"),
-            out_act=self.conf.active_type or "tanh")
+            cell_act=self.conf.active_type or "tanh",
+            out_act=self.conf.attrs.get("active_state_type", "tanh"))
         return out
 
 
@@ -137,8 +139,8 @@ class LstmStepLayer(Layer):
             x, LstmState(h=jnp.zeros_like(c_prev), c=c_prev), None,
             ci, cf, co,
             gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
-            cell_act=self.conf.attrs.get("active_state_type", "tanh"),
-            out_act=self.conf.active_type or "tanh")
+            cell_act=self.conf.active_type or "tanh",
+            out_act=self.conf.attrs.get("active_state_type", "tanh"))
         # expose (h, c); network stores dict outputs by name suffix
         return {"out": like(inputs[0], out), "state": like(inputs[0], state.c)}
 

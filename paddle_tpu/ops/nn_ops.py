@@ -235,7 +235,10 @@ def batch_norm(x, scale, bias, running_mean, running_var,
     axes = tuple(i for i in range(x.ndim) if i != (x.ndim - 1 if data_format.endswith("C") else 1))
     if is_training:
         m = jnp.mean(x, axis=axes, dtype=jnp.float32)
-        m2 = jnp.mean(x * x, axis=axes, dtype=jnp.float32)
+        # square in fp32: the upcast happens in-register on the same bf16
+        # read, and a bf16 x*x loses all low bits when |mean| >> std,
+        # collapsing the E[x²]−E[x]² difference to 0
+        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
         v = jnp.maximum(m2 - m * m, 0.0)
         new_rm = momentum * running_mean + (1 - momentum) * m
         new_rv = momentum * running_var + (1 - momentum) * v

@@ -291,6 +291,7 @@ def test_multibox_loss_layer_end_to_end(rng):
 
 # ------------------------------------------------ mdlstm / beam CE
 
+@pytest.mark.slow
 def test_mdlstm_grad_and_shapes(rng):
     d, H, W = 3, 3, 3
     gw = 5 * d  # (3+nd)*d, nd=2

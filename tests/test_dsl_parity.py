@@ -259,31 +259,94 @@ def test_sub_nested_seq_layer_selects_subsequences():
     assert int(out.num_subseq[1]) == 1     # -1 padding dropped
 
 
+def _build_lstm_step_group():
+    """The reference ``lstmemory_group`` recipe (networks.py:644 /
+    layers.py:3490): the layer's own output memory carries h, a
+    ``.state`` memory carries c, and ``lstm_step_layer`` gets exactly
+    TWO inputs — the 4H gate projection (which already folds in
+    W*h_prev) and the previous cell."""
+    from paddle_tpu.data.feeder import dense_vector_sequence
+
+    s = dsl.data_layer("s", dense_vector_sequence(6))
+
+    def step(frame):
+        m = dsl.memory(name="lstm_out", size=2)
+        c = dsl.memory(name="lstm_out.state", size=2)
+        gates = dsl.fc_layer(input=[frame, m.out], size=8,
+                             act=dsl.LinearActivation(),
+                             bias_attr=False, name="gates")
+        out = dsl.lstm_step_layer(gates, c.out, size=2, name="lstm_out",
+                                  bias_attr=False)
+        cell = dsl.get_output_layer(out, "state", name="cell_seq")
+        return [out, cell]
+
+    return dsl.recurrent_group(step, [dsl.StepInput(s)], name="g")
+
+
 def test_get_output_layer_reads_named_output():
     """get_output_layer must address a layer's extra output through the
-    dotted value convention (lstm step exposes .state)."""
-    import jax.numpy as jnp
+    dotted value convention (lstm step exposes .state), and the group
+    must accept separate hidden + cell memories."""
     from paddle_tpu.core.sequence import pad_batch
 
     with config_scope():
-        from paddle_tpu.data.feeder import dense_vector_sequence
-        s = dsl.data_layer("s", dense_vector_sequence(6))
-
-        def step(frame):
-            m = dsl.memory(name="lstm_out", size=2)
-            c = dsl.memory(name="lstm_out.state", size=2)
-            out = dsl.lstm_step_layer(frame, m.out, c.out, size=2,
-                                      name="lstm_out")
-            return out
-
-        group = dsl.recurrent_group(step, [dsl.StepInput(s)], name="g")
-        got = dsl.get_output_layer(group, "out", name="sel")
-        cfg = dsl.topology(dsl.pooling_layer(
-            got, pooling_type=dsl.MaxPooling()))
+        out, cell = _build_lstm_step_group()
+        cfg = dsl.topology([out, cell, dsl.pooling_layer(
+            cell, pooling_type=dsl.MaxPooling(), name="pool")])
     net = NeuralNetwork(cfg)
     params = net.init_params()
     rng = np.random.RandomState(5)
-    sb = pad_batch([rng.randn(4, 6).astype(np.float32),
-                    rng.randn(2, 6).astype(np.float32)])
+    raw = [rng.randn(4, 6).astype(np.float32),
+           rng.randn(2, 6).astype(np.float32)]
+    sb = pad_batch(raw)
     values, _ = net.forward(params, {"s": sb})
-    assert values["sel"].data.shape == (2, 4, 2)
+    h_seq = np.asarray(values["lstm_out"].data)
+    c_seq = np.asarray(values["cell_seq"].data)
+    t_pad = h_seq.shape[1]
+    assert h_seq.shape == (2, t_pad, 2) and c_seq.shape == (2, t_pad, 2)
+    assert t_pad >= 4
+
+    # manual reference loop: gates = [x, h_prev] @ [W0; W1], i f c o split
+    names = sorted(k for k in params if "gates" in k)
+    assert len(names) == 2, names
+    w_x, w_h = (np.asarray(params[names[0]]), np.asarray(params[names[1]]))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for bi, x in enumerate(raw):
+        h = np.zeros(2, np.float32)
+        c = np.zeros(2, np.float32)
+        for t in range(x.shape[0]):
+            g = x[t] @ w_x + h @ w_h
+            i, f, ci, o = g[0:2], g[2:4], g[4:6], g[6:8]
+            c = sig(f) * c + sig(i) * np.tanh(ci)
+            h = sig(o) * np.tanh(c)
+            np.testing.assert_allclose(h_seq[bi, t], h, atol=2e-5)
+            np.testing.assert_allclose(c_seq[bi, t], c, atol=2e-5)
+        for t in range(x.shape[0], t_pad):   # padded steps masked to 0
+            np.testing.assert_allclose(h_seq[bi, t], 0.0, atol=0)
+
+
+def test_lstm_step_group_hoisting_equivalence():
+    """Epilogue hoisting must be bit-identical on a group whose memories
+    include a dict sub-output ('.state') — the ADVICE repro."""
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.layers.recurrent_group import RecurrentGroup
+
+    with config_scope():
+        out, cell = _build_lstm_step_group()
+        cfg = dsl.topology([out, cell])
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    rng = np.random.RandomState(7)
+    sb = pad_batch([rng.randn(3, 6).astype(np.float32),
+                    rng.randn(5, 6).astype(np.float32)])
+    try:
+        RecurrentGroup.HOIST = True
+        v_h, _ = net.forward(params, {"s": sb})
+        RecurrentGroup.HOIST = False
+        v_n, _ = net.forward(params, {"s": sb})
+    finally:
+        RecurrentGroup.HOIST = True
+    np.testing.assert_array_equal(np.asarray(v_h["cell_seq"].data),
+                                  np.asarray(v_n["cell_seq"].data))
+    np.testing.assert_array_equal(np.asarray(v_h["lstm_out"].data),
+                                  np.asarray(v_n["lstm_out"].data))

@@ -131,6 +131,7 @@ def test_batch_norm_buffers(rng):
     assert np.isfinite(np.asarray(values2["test"])).all()
 
 
+@pytest.mark.slow
 def test_lstmemory_grad(rng):
     net = build_single_layer_net("lstmemory", size=3, input_sizes=[12],
                                  with_bias=True)

@@ -55,6 +55,7 @@ def test_deep_net_finite_at_init():
     assert np.isfinite(out).all()
 
 
+@pytest.mark.slow
 def test_cli_time_job():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",

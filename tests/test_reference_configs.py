@@ -79,6 +79,7 @@ def _run_time_job(config: str, config_args: str, cwd, timeout: int = 840):
     return out
 
 
+@pytest.mark.slow
 def test_time_job_from_reference_config(tmp_path):
     """End-to-end ``--job=time`` driven by the reference smallnet config
     AND the reference image provider.py (xrange, settings.slots,
@@ -88,6 +89,7 @@ def test_time_job_from_reference_config(tmp_path):
                   "batch_size=16", tmp_path)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["alexnet", "googlenet", "vgg"])
 def test_time_job_reference_image_configs(name, tmp_path):
     """alexnet/googlenet/vgg TRAIN a real step end-to-end (not just
@@ -100,6 +102,7 @@ def test_time_job_reference_image_configs(name, tmp_path):
                   "batch_size=2", tmp_path)
 
 
+@pytest.mark.slow
 def test_time_job_reference_rnn_config(tmp_path):
     """rnn.py trains end-to-end through the reference's own imdb
     provider (``benchmark/paddle/rnn/run.sh`` contract)."""

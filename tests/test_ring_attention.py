@@ -41,6 +41,7 @@ def test_ulysses_matches_full(mesh, causal, rng):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match(mesh, rng):
     """Autodiff through the ring (training path) equals full-attention
     gradients."""
