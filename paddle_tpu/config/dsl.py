@@ -76,6 +76,31 @@ def _act_name(act) -> str:
 
 
 @dataclass
+class HookAttribute:
+    """``attrs.py`` HookAttribute — parameter updater hook spec.
+
+    ``HookAttribute('pruning', sparsity_ratio=0.6)`` attaches the static
+    pruning hook (``ParameterUpdaterHook.cpp:39`` StaticPruningHook): at
+    init the smallest ``sparsity_ratio`` fraction of |w| is zeroed and the
+    mask is applied to every subsequent gradient.
+    """
+
+    type: str = "pruning"
+    sparsity_ratio: Optional[float] = 0.6
+
+    def as_dict(self) -> Dict[str, Any]:
+        enforce(self.type == "pruning",
+                f"unknown parameter hook type {self.type!r}")
+        if self.sparsity_ratio is not None:
+            enforce(0.0 <= self.sparsity_ratio <= 1.0,
+                    "sparsity_ratio must be in [0, 1]")
+        return {"type": self.type, "sparsity_ratio": self.sparsity_ratio}
+
+
+HookAttr = HookAttribute
+
+
+@dataclass
 class ParamAttr:
     """``attrs.py`` ParameterAttribute."""
 
@@ -89,6 +114,7 @@ class ParamAttr:
     is_static: bool = False
     sparse_update: bool = False
     initial_smart: bool = True
+    update_hooks: Optional[Any] = None  # HookAttribute or list thereof
 
 
 @dataclass
@@ -214,6 +240,8 @@ def _register_param_attr(owner_name: str, pa: Optional[ParamAttr],
         initial_smart=pa.initial_smart and pa.initial_std is None,
         is_static=pa.is_static,
         sparse_update=pa.sparse_update,
+        update_hooks=[h.as_dict() for h in _as_list(pa.update_hooks)]
+        if pa.update_hooks else [],
     )
     _collector.parameters.append(pc)
 

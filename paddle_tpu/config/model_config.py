@@ -38,6 +38,9 @@ class ParameterConfig:
     is_sparse: bool = False
     sparse_update: bool = False
     sharded: bool = False               # TPU: shard over 'model' axis
+    # ParameterUpdaterHookConfig list, e.g.
+    # [{"type": "pruning", "sparsity_ratio": 0.6}]
+    update_hooks: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass

@@ -93,6 +93,13 @@ class Trainer:
         self.samples_seen = 0
         if FLAGS.init_model_path:
             self.load(FLAGS.init_model_path)
+        # static pruning hooks (ParameterUpdaterHook.cpp:39): masks are
+        # generated from the initial/loaded values, applied to the value
+        # now and to every gradient inside the train step
+        from ..optimizer.hooks import apply_prune_init, build_prune_masks
+        self._prune_masks = build_prune_masks(network.param_specs,
+                                              self.params)
+        self.params = apply_prune_init(self.params, self._prune_masks)
 
     # ----------------------------------------------------------- sharding
     def _shard_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
@@ -173,6 +180,9 @@ class Trainer:
 
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if self._prune_masks:
+                from ..optimizer.hooks import apply_prune_grads
+                grads = apply_prune_grads(grads, self._prune_masks)
             lr = self.schedule(progress)
             masks = None
             if sparse_names:
@@ -394,6 +404,14 @@ class Trainer:
             self.samples_seen = load_manifest(ckpt_dir).get("samples_seen", 0)
         except FileNotFoundError:
             pass
+        if getattr(self, "_prune_masks", None):
+            # regenerate pruning masks from the LOADED values (the
+            # reference hook inits after any --init_model_path load)
+            from ..optimizer.hooks import apply_prune_init, build_prune_masks
+            self._prune_masks = build_prune_masks(
+                self.network.param_specs, self.params)
+            self.params = apply_prune_init(self.params, self._prune_masks)
+            self._train_step = None  # re-capture the new masks
 
     def resume(self, save_dir: str) -> bool:
         ckpt = latest_checkpoint(save_dir)

@@ -1,6 +1,7 @@
-from ..config.dsl import ExtraAttr, ParamAttr  # noqa: F401
+from ..config.dsl import ExtraAttr, HookAttr, HookAttribute, ParamAttr  # noqa: F401
 
 Param = ParamAttr
 Extra = ExtraAttr
+Hook = HookAttribute
 ParameterAttribute = ParamAttr
 ExtraLayerAttribute = ExtraAttr

@@ -156,3 +156,45 @@ def test_model_average(rng):
     avg = ma.average(st)
     # window saw [0, 1, 2, 3, 4] -> mean 2.0
     np.testing.assert_allclose(np.asarray(avg["x"]), 2.0, rtol=1e-6)
+
+
+def test_static_pruning_hook_masks_stay_zero():
+    """StaticPruningHook parity (ParameterUpdaterHook.cpp:39): the
+    smallest sparsity_ratio fraction of |w| is zeroed at init and stays
+    EXACTLY zero through training; surviving weights keep updating."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.layers import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+    from paddle_tpu.optimizer.optimizers import Momentum
+
+    with config_scope():
+        from paddle_tpu.data.feeder import dense_vector, integer_value
+        x = dsl.data_layer("x", dense_vector(16))
+        y = dsl.data_layer("y", integer_value(4))
+        hid = dsl.fc_layer(
+            x, size=32,
+            param_attr=dsl.ParamAttr(
+                update_hooks=dsl.HookAttribute("pruning",
+                                               sparsity_ratio=0.75)))
+        pred = dsl.fc_layer(hid, size=4, act=dsl.SoftmaxActivation())
+        cfg = dsl.topology(dsl.classification_cost(pred, y))
+    net = NeuralNetwork(cfg)
+    tr = Trainer(net, optimizer=Momentum(learning_rate=0.1, momentum=0.9))
+
+    wname = "_" + hid.name + ".w0"
+    w0 = np.asarray(tr.params[wname])
+    mask = (w0 != 0).astype(np.float32)
+    kept = int(mask.sum())
+    assert kept == int(w0.size * 0.25), (kept, w0.size)
+
+    rng = np.random.RandomState(3)
+    feed = {"x": jnp.asarray(rng.randn(8, 16).astype(np.float32)),
+            "y": jnp.asarray(rng.randint(0, 4, size=(8,)))}
+    for _ in range(5):
+        tr.train_one_batch(dict(feed))
+    w = np.asarray(tr.params[wname])
+    # pruned entries exactly zero; survivors moved
+    np.testing.assert_array_equal(w * (1 - mask), 0.0)
+    assert np.abs(w - w0).max() > 0
+    assert np.any((w != w0) & (mask > 0))
