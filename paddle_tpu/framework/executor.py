@@ -20,7 +20,6 @@ inside the single compiled computation.
 from __future__ import annotations
 
 import os
-import pickle
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -347,18 +346,19 @@ def _to_numpy(v):
 
 
 def _host_save(op: Operator, scope: Scope) -> None:
+    # save_op.cc equivalent; npz (not pickle) so loading an untrusted
+    # checkpoint cannot execute code
     path = op.attrs["file_path"]
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     data = {n: np.asarray(value_of(scope.find(n)))
             for n in op.input("X")}
     with open(path, "wb") as f:
-        pickle.dump(data, f)
+        np.savez(f, **data)
 
 
 def _host_load(op: Operator, scope: Scope) -> None:
     path = op.attrs["file_path"]
-    with open(path, "rb") as f:
-        data = pickle.load(f)
-    for n in op.output("Out"):
-        enforce(n in data, f"checkpoint {path} lacks variable {n!r}")
-        scope.set(n, jnp.asarray(data[n]))
+    with np.load(path) as data:
+        for n in op.output("Out"):
+            enforce(n in data, f"checkpoint {path} lacks variable {n!r}")
+            scope.set(n, jnp.asarray(data[n]))

@@ -15,7 +15,7 @@ to :class:`paddle_tpu.layers.recurrent_group.RecurrentGroup`).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,18 +135,73 @@ class NeuralNetwork:
             for n in params
         }
 
+    def _ancestors(self, targets) -> Set[str]:
+        """Main-graph layers (transitively) needed to produce ``targets``
+        — inference pruning, the ``core.prune`` / capi
+        create-for-inference equivalent.  Group out-links pull in the
+        whole group: its in-links, memory boot layers, and every outer
+        value its step layers read."""
+        needed: Set[str] = set()
+        stack = [t for t in targets]
+        seen: Set[str] = set()
+        while stack:
+            v = stack.pop()
+            if v in seen:
+                continue
+            seen.add(v)
+            base = v.split(".", 1)[0]
+            if base not in self.layers:
+                base = v
+            if base in self.layers:
+                needed.add(base)
+                stack.extend(self.layers[base].conf.input_names())
+                continue
+            gname = self.group_of.get(v)
+            if gname is None:
+                continue
+            grp = self.groups.get(gname)
+            sub = grp.sub if grp is not None else self.gen_groups[gname]
+            stack.extend(sub.in_links)
+            # beam-search groups read encoder context as static inputs
+            # (deliberately NOT in_links, dsl.py GeneratedInput wiring)
+            stack.extend(sub.generator.get("static_inputs", ()))
+            step_layers = (grp.layers if grp is not None
+                           else self._decoders[gname].group.layers)
+            inner = set(step_layers) | set(sub.layer_names)
+            mem_links = set()
+            for m in sub.memories:
+                mem_links.add(m.get("link_name",
+                                    m["layer_name"] + "@pre"))
+                if m.get("boot_layer_name"):
+                    stack.append(m["boot_layer_name"])
+            for lyr in step_layers.values():
+                for iname in lyr.conf.input_names():
+                    head = iname.split(".", 1)[0]
+                    if head not in inner and iname not in mem_links \
+                            and iname not in sub.in_links:
+                        stack.append(iname)
+        return needed
+
     # ------------------------------------------------------------ forward
     def forward(self, params: Dict[str, jax.Array], feed: Dict[str, Any],
                 buffers: Optional[Dict[str, jax.Array]] = None,
                 is_training: bool = True,
-                rng: Optional[jax.Array] = None
+                rng: Optional[jax.Array] = None,
+                only: Optional[Sequence[str]] = None
                 ) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
-        """Run all layers; returns (all outputs by name, updated buffers)."""
+        """Run all layers; returns (all outputs by name, updated buffers).
+
+        ``only``: restrict execution to the ancestors of these value
+        names — data layers outside the cone need no feed (inference on
+        a training config)."""
         ctx = ForwardContext(is_training=is_training, rng=rng,
                              buffers=buffers or {})
         values: Dict[str, Any] = {}
         done_groups: Set[str] = set()
+        needed = self._ancestors(only) if only is not None else None
         for name in self.order:
+            if needed is not None and name not in needed:
+                continue
             layer = self.layers[name]
             if layer.conf.type == "data":
                 if name not in feed:
@@ -169,7 +224,7 @@ class NeuralNetwork:
                 values[name] = out
         # declared outputs that are group out-links with no downstream
         # consumer still need their group to run
-        for name in self.output_names:
+        for name in (self.output_names if only is None else only):
             gname = self.group_of.get(name)
             if name in values or gname is None or gname in done_groups:
                 continue

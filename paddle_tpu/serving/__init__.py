@@ -1,0 +1,18 @@
+"""Deployment/serving — the TPU-native answer to ``paddle/capi``.
+
+The reference ships a pure-C inference API
+(``paddle/capi/gradient_machine.h:36-88``: create-for-inference, forward,
+shared-parameter clones for multi-threaded serving) so trained models run
+in processes that embed none of the training framework.  On TPU the
+equivalent artifact is a **StableHLO module** (`jax.export`): the whole
+inference function — topology and weights — compiled to a stable,
+versioned IR that any PJRT runtime can execute with zero framework code.
+
+- :mod:`paddle_tpu.serving.export` — build the artifact from a trained
+  network / v2 inferer / framework program.
+- :mod:`paddle_tpu.serving.loader` — standalone loader (imports only
+  jax + numpy + json; never the layer engine).
+"""
+
+from .export import export_inference_fn, export_network  # noqa: F401
+from .loader import ServedModel  # noqa: F401

@@ -1,0 +1,145 @@
+"""Export an inference function to a standalone StableHLO artifact.
+
+Artifact layout (versioned, like ``trainer/checkpoint.py``'s manifest):
+
+    <dir>/manifest.json   {"format": "paddle-tpu-serving", "version": 1,
+                           "feeds": [{name, shape, dtype}...],
+                           "fetches": [name...],
+                           "module": "model.stablehlo",
+                           "batch_polymorphic": bool}
+    <dir>/model.stablehlo  jax.export serialized bytes (weights baked in)
+
+Weights are baked into the module as constants — the artifact is the
+whole deployable model, the same way ``paddle_merge_model`` fuses config
++ parameters into one self-contained file for the C inference API
+(``paddle/trainer/MergeModel.cpp``, ``paddle/capi/gradient_machine.h:36``).
+
+Reference parity: replaces ``paddle_gradient_machine_create_for_inference
+_with_parameters`` + ``_forward``; multi-threaded serving needs no
+``_create_shared_param`` equivalent — the loaded module is a pure
+function, reentrant by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils import enforce, get_logger
+
+log = get_logger("serving")
+
+FORMAT_NAME = "paddle-tpu-serving"
+FORMAT_VERSION = 1
+MODULE_FILE = "model.stablehlo"
+
+
+def _feed_spec(name: str, arr: np.ndarray, poly_batch: bool) -> Dict[str, Any]:
+    return {"name": name,
+            "shape": [None if (poly_batch and i == 0) else int(d)
+                      for i, d in enumerate(np.shape(arr))],
+            "dtype": str(np.asarray(arr).dtype)}
+
+
+def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
+                        fetch_names: Sequence[str],
+                        batch_polymorphic: bool = True) -> str:
+    """Export ``fn(feed_dict) -> dict[name, array]`` to ``dirname``.
+
+    ``fn`` must be traceable (weights closed over; they are baked into
+    the module).  With ``batch_polymorphic`` the leading axis of every
+    feed is exported symbolically so one artifact serves any batch size.
+    """
+    feed_names = sorted(example_feed)
+    examples = {k: np.asarray(example_feed[k]) for k in feed_names}
+
+    def flat_fn(*args):
+        out = fn(dict(zip(feed_names, args)))
+        return [out[n] for n in fetch_names]
+
+    def specs(poly: bool):
+        if not poly:
+            return [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in (examples[k] for k in feed_names)]
+        scope = jax.export.SymbolicScope()
+        b = jax.export.symbolic_shape("b", scope=scope)[0]
+        out = []
+        for k in feed_names:
+            a = examples[k]
+            shape = ((b,) + a.shape[1:]) if a.ndim >= 1 else a.shape
+            out.append(jax.ShapeDtypeStruct(shape, a.dtype))
+        return out
+
+    # one artifact serves every runtime: lower for cpu AND tpu
+    # (jax.export multi-platform lowering)
+    platforms = ("cpu", "tpu")
+
+    def do_export(poly: bool):
+        return jax.export.export(jax.jit(flat_fn),
+                                 platforms=platforms)(*specs(poly))
+
+    exported = None
+    poly = batch_polymorphic
+    if poly:
+        try:
+            exported = do_export(True)
+        except Exception as e:  # shapes data-dependent on batch size
+            log.warning(
+                "batch-polymorphic export failed (%s: %s); falling back "
+                "to fixed batch %s", type(e).__name__, e,
+                {k: np.shape(v) for k, v in examples.items()})
+            poly = False
+    if exported is None:
+        exported = do_export(False)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, MODULE_FILE), "wb") as f:
+        f.write(exported.serialize())
+    manifest = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        "feeds": [_feed_spec(k, examples[k], poly) for k in feed_names],
+        "fetches": list(fetch_names),
+        "module": MODULE_FILE,
+        "batch_polymorphic": poly,
+    }
+    with open(os.path.join(dirname, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return dirname
+
+
+def export_network(network, params: Dict[str, jax.Array],
+                   example_feed: Dict[str, Any], dirname: str,
+                   output_names: Optional[Sequence[str]] = None,
+                   buffers: Optional[Dict[str, jax.Array]] = None,
+                   batch_polymorphic: bool = True) -> str:
+    """Export a layer-engine :class:`NeuralNetwork` for inference.
+
+    ``output_names`` defaults to the network's declared outputs (cost
+    layers replaced by their prediction input, as ``v2.infer`` does).
+    """
+    from ..core.sequence import value_of
+
+    if output_names is None:
+        output_names = []
+        for n in network.output_names:
+            lyr = network.layers.get(n)
+            if lyr is not None and getattr(lyr, "is_cost", False) \
+                    and lyr.conf.inputs:
+                output_names.append(lyr.conf.inputs[0].input_layer_name)
+            else:
+                output_names.append(n)
+    enforce(output_names, "export_network: no output names")
+    bufs = buffers if buffers is not None else network.init_buffers()
+
+    def fn(feed):
+        values, _ = network.forward(params, feed, bufs, is_training=False,
+                                    only=output_names)
+        return {n: value_of(values[n]) for n in output_names}
+
+    return export_inference_fn(fn, example_feed, dirname, output_names,
+                               batch_polymorphic=batch_polymorphic)

@@ -1,0 +1,66 @@
+"""Standalone serving loader.
+
+Deliberately imports ONLY ``jax``, ``numpy`` and the stdlib — never the
+layer engine, DSL, or trainer.  This is the deployment boundary the
+reference draws with ``paddle/capi`` (a C library embedding none of the
+trainer): a serving process ships the artifact directory plus this one
+file's worth of code.
+
+    from paddle_tpu.serving.loader import ServedModel
+    model = ServedModel.load("exported_mnist/")
+    probs = model(img=batch)["prediction"]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+
+class ServedModel:
+    """A loaded StableHLO inference artifact (pure function; reentrant —
+    the multi-thread story ``_create_shared_param`` exists for in the
+    reference C API comes for free)."""
+
+    def __init__(self, manifest: Dict[str, Any], exported):
+        self.manifest = manifest
+        self._exported = exported
+        self.feed_names = [f["name"] for f in manifest["feeds"]]
+        self.fetch_names = list(manifest["fetches"])
+
+    @classmethod
+    def load(cls, dirname: str) -> "ServedModel":
+        with open(os.path.join(dirname, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != "paddle-tpu-serving":
+            raise ValueError(f"{dirname}: not a paddle-tpu-serving artifact")
+        if manifest.get("version", 0) > 1:
+            raise ValueError(
+                f"{dirname}: artifact version {manifest['version']} is newer "
+                "than this loader (supports <= 1)")
+        with open(os.path.join(dirname, manifest["module"]), "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        return cls(manifest, exported)
+
+    def __call__(self, **feeds) -> Dict[str, np.ndarray]:
+        args = []
+        for spec in self.manifest["feeds"]:
+            name = spec["name"]
+            if name not in feeds:
+                raise KeyError(f"missing feed {name!r} "
+                               f"(expected {self.feed_names})")
+            a = np.asarray(feeds[name], dtype=spec["dtype"])
+            want = spec["shape"]
+            got = list(a.shape)
+            if len(want) != len(got) or any(
+                    w is not None and w != g for w, g in zip(want, got)):
+                raise ValueError(
+                    f"feed {name!r}: shape {got} incompatible with {want}")
+            args.append(a)
+        outs = self._exported.call(*args)
+        return {n: np.asarray(v)
+                for n, v in zip(self.fetch_names, outs)}
