@@ -102,6 +102,46 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_merge_model(args) -> int:
+    """``paddle_merge_model`` (``paddle/trainer/MergeModel.cpp``): config
+    + trained parameters → ONE self-contained model file."""
+    from .config.config_parser import parse_config
+    from .trainer import interop
+
+    model, _opt, _ds = parse_config(args.config_file, args.config_args)
+    model = interop.with_full_param_specs(model)
+    params = interop.checkpoint_to_params(args.model_dir)
+    if not params:  # reference raw-buffer pass-%05d layout
+        params = interop.load_reference_model_dir(args.model_dir, model)
+    missing = [p.name for p in model.parameters if p.name not in params]
+    if missing:
+        log.error("model_dir %s lacks parameters: %s", args.model_dir,
+                  missing)
+        return 1
+    interop.merge_model(model, params, args.model_file)
+    print(json.dumps({"job": "merge_model", "out": args.model_file,
+                      "parameters": len(model.parameters)}))
+    return 0
+
+
+def cmd_dump_config(args) -> int:
+    """``dump_config``/``show_pb`` equivalent
+    (``python/paddle/utils/dump_config.py``): print the parsed model
+    config (``--whole`` adds optimization + data config)."""
+    from .config.config_parser import parse_config
+
+    model, opt, ds = parse_config(args.config, args.config_args)
+    if args.whole:
+        import dataclasses
+        payload = {"model": json.loads(model.to_json()),
+                   "opt": dataclasses.asdict(opt),
+                   "data": dataclasses.asdict(ds) if ds else None}
+        print(json.dumps(payload, indent=1))
+    else:
+        print(model.to_json())
+    return 0
+
+
 def cmd_version(_args) -> int:
     import jax
 
@@ -130,6 +170,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     tp.add_argument("--use_bf16", type=int, default=None)
     tp.add_argument("--bf16_activations", type=int, default=None)
     tp.set_defaults(fn=cmd_train)
+
+    mp = sub.add_parser(
+        "merge_model",
+        help="fuse config + trained parameters into one model file")
+    mp.add_argument("--model_dir", required=True,
+                    help="pass-%%05d checkpoint dir (ours or reference "
+                         "raw-buffer layout)")
+    mp.add_argument("--config_file", required=True)
+    mp.add_argument("--model_file", required=True,
+                    help="output merged model path")
+    mp.add_argument("--config_args", default="")
+    mp.set_defaults(fn=cmd_merge_model)
+
+    dp = sub.add_parser("dump_config",
+                        help="parse a config file and print the model IR")
+    dp.add_argument("config")
+    dp.add_argument("config_args", nargs="?", default="")
+    dp.add_argument("--whole", action="store_true",
+                    help="include optimization + data config")
+    dp.set_defaults(fn=cmd_dump_config)
 
     vp = sub.add_parser("version", help="print build info")
     vp.set_defaults(fn=cmd_version)

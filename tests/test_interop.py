@@ -1,0 +1,158 @@
+"""Model-file interop: Parameter raw buffers, merge_model, dump_config.
+
+References: ``paddle/parameter/Parameter.h:263-267`` (header layout),
+``paddle/trainer/MergeModel.cpp`` (merged-file framing),
+``python/paddle/utils/dump_config.py``.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.trainer import interop
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parameter_header_bit_layout(tmp_path):
+    """Byte-for-byte the reference ``Parameter::save`` stream: int32
+    format, uint32 valueSize=4, uint64 size, then fp32 data."""
+    v = np.array([1.5, -2.0, 0.25], np.float32)
+    p = str(tmp_path / "w")
+    interop.save_parameter_file(p, v)
+    raw = open(p, "rb").read()
+    fmt, vsize, size = struct.unpack("<iIQ", raw[:16])
+    assert (fmt, vsize, size) == (0, 4, 3)
+    np.testing.assert_array_equal(
+        np.frombuffer(raw[16:], np.float32), v)
+    # and read back
+    np.testing.assert_array_equal(interop.load_parameter_file(p), v)
+
+
+def test_reference_model_dir_round_trip(tmp_path):
+    rng = np.random.RandomState(0)
+    params = {"_fc.w0": rng.randn(4, 3).astype(np.float32),
+              "_fc.wbias": rng.randn(3).astype(np.float32)}
+    d = str(tmp_path / "pass-00000")
+    interop.save_reference_model_dir(d, params)
+    # each parameter is its own raw-buffer file named by parameter name
+    assert sorted(os.listdir(d)) == ["_fc.w0", "_fc.wbias"]
+
+    from paddle_tpu.config.model_config import ModelConfig, ParameterConfig
+    model = ModelConfig(parameters=[
+        ParameterConfig(name="_fc.w0", size=12, dims=[4, 3]),
+        ParameterConfig(name="_fc.wbias", size=3, dims=[3]),
+    ])
+    loaded = interop.load_reference_model_dir(d, model)
+    np.testing.assert_array_equal(loaded["_fc.w0"], params["_fc.w0"])
+    assert loaded["_fc.w0"].shape == (4, 3)
+
+
+def test_unsupported_format_rejected(tmp_path):
+    p = str(tmp_path / "bad")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<iIQ", 1, 4, 2))  # MKLDNN packed format
+        f.write(np.zeros(2, np.float32).tobytes())
+    with pytest.raises(Exception, match="unsupported parameter format"):
+        interop.load_parameter_file(p)
+
+
+def _train_mnist_config(tmp_path):
+    cfg = tmp_path / "mnist_conf.py"
+    cfg.write_text(
+        "from paddle_tpu.config.config_parser import *\n"
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "img = data_layer('img', size=64)\n"
+        "lbl = data_layer('label', size=10)\n"
+        "hid = fc_layer(input=img, size=16)\n"
+        "pred = fc_layer(input=hid, size=10, act=SoftmaxActivation(),\n"
+        "                name='prediction')\n"
+        "outputs(classification_cost(input=pred, label=lbl))\n")
+    return str(cfg)
+
+
+def test_merge_model_cli_round_trip(tmp_path):
+    """Train 1 step, checkpoint, merge via CLI, load merged, and get
+    IDENTICAL logits from the merged file's config+params."""
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.core.sequence import value_of
+    from paddle_tpu.layers import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+
+    cfg_path = _train_mnist_config(tmp_path)
+    model, opt, _ = parse_config(cfg_path, "")
+    net = NeuralNetwork(model)
+    tr = Trainer(net, opt_config=opt)
+    rng = np.random.RandomState(5)
+    import jax.numpy as jnp
+    feed = {"img": jnp.asarray(rng.randn(8, 64).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 10, (8,)))}
+    tr.train_one_batch(dict(feed))
+    ckpt = tr.save(str(tmp_path / "out"), 0)
+
+    merged = str(tmp_path / "model.paddle")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "merge_model",
+         "--model_dir", ckpt, "--config_file", cfg_path,
+         "--model_file", merged],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert json.loads(r.stdout.strip().splitlines()[-1])["parameters"] > 0
+
+    model2, params2 = interop.load_merged_model(merged)
+    net2 = NeuralNetwork(model2)
+    p2 = {k: jnp.asarray(v) for k, v in params2.items()}
+    x = {"img": feed["img"]}
+    v1, _ = net.forward(tr.params, x, tr.buffers, is_training=False,
+                        only=["prediction"])
+    v2, _ = net2.forward(p2, x, net2.init_buffers(), is_training=False,
+                         only=["prediction"])
+    np.testing.assert_array_equal(np.asarray(value_of(v1["prediction"])),
+                                  np.asarray(value_of(v2["prediction"])))
+
+
+def test_merge_model_reads_reference_layout_dir(tmp_path):
+    """A reference-trained pass dir (raw Parameter::save files) merges
+    and loads — the reference-model import path."""
+    from paddle_tpu.config.config_parser import parse_config
+
+    cfg_path = _train_mnist_config(tmp_path)
+    model, _, _ = parse_config(cfg_path, "")
+    model = interop.with_full_param_specs(model)
+    rng = np.random.RandomState(1)
+    params = {p.name: rng.randn(*p.dims).astype(np.float32)
+              for p in model.parameters}
+    d = str(tmp_path / "pass-00000")
+    interop.save_reference_model_dir(d, params)
+
+    loaded = interop.load_reference_model_dir(d, model, strict=True)
+    merged = str(tmp_path / "m.paddle")
+    interop.merge_model(model, loaded, merged)
+    model2, params2 = interop.load_merged_model(merged)
+    for name in params:
+        np.testing.assert_array_equal(params2[name], params[name])
+        assert params2[name].shape == params[name].shape
+
+
+def test_dump_config_cli(tmp_path):
+    cfg_path = _train_mnist_config(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "dump_config", cfg_path,
+         "--whole"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    payload = json.loads(r.stdout)
+    types = [l["type"] for l in payload["model"]["layers"]]
+    assert "fc" in types and "data" in types
+    assert payload["opt"]["batch_size"] == 8
