@@ -1,0 +1,101 @@
+# GAN config (reference ``v1_api_demo/gan/gan_conf.py``): one config
+# file, four modes selected with --config_args mode=...:
+#   generator_training      noise -> G -> D(frozen) -> cost
+#   discriminator_training  sample -> D -> cost
+#   generator               noise -> G (inference)
+#   discriminator           sample -> D (inference)
+# The alternating-freeze trick is ParamAttr(is_static=...) exactly as the
+# reference does it; the two training topologies share parameters BY NAME
+# (the MultiNetwork capability, paddle/gserver/gradientmachines/
+# MultiNetwork.h, driven from demo/gan/train.py).
+from paddle_tpu.config.config_parser import *
+
+mode = get_config_arg("mode", str, "generator")
+assert mode in set([
+    "generator", "discriminator", "generator_training",
+    "discriminator_training"
+])
+
+is_generator_training = mode == "generator_training"
+is_discriminator_training = mode == "discriminator_training"
+is_generator = mode == "generator"
+is_discriminator = mode == "discriminator"
+
+# GAN per Goodfellow et al. 1406.2661: two hidden layers + batch_norm
+noise_dim = 10
+hidden_dim = 10
+sample_dim = 2
+
+settings(
+    batch_size=128,
+    learning_rate=1e-3,
+    learning_method=AdamOptimizer(beta1=0.5))
+
+
+def discriminator(sample):
+    """P(sample is real); output dim 0 = fake, dim 1 = real."""
+    param_attr = ParamAttr(is_static=is_generator_training)
+    bias_attr = ParamAttr(
+        is_static=is_generator_training, initial_mean=1.0, initial_std=0)
+
+    hidden = fc_layer(input=sample, name="dis_hidden", size=hidden_dim,
+                      bias_attr=bias_attr, param_attr=param_attr,
+                      act=ReluActivation())
+    hidden2 = fc_layer(input=hidden, name="dis_hidden2", size=hidden_dim,
+                       bias_attr=bias_attr, param_attr=param_attr,
+                       act=LinearActivation())
+    hidden_bn = batch_norm_layer(
+        hidden2, act=ReluActivation(), name="dis_hidden_bn",
+        bias_attr=bias_attr,
+        param_attr=ParamAttr(is_static=is_generator_training,
+                             initial_mean=1.0, initial_std=0.02),
+        use_global_stats=False)
+    return fc_layer(input=hidden_bn, name="dis_prob", size=2,
+                    bias_attr=bias_attr, param_attr=param_attr,
+                    act=SoftmaxActivation())
+
+
+def generator(noise):
+    """Generate a sample from noise."""
+    param_attr = ParamAttr(is_static=is_discriminator_training)
+    bias_attr = ParamAttr(
+        is_static=is_discriminator_training, initial_mean=1.0,
+        initial_std=0)
+
+    hidden = fc_layer(input=noise, name="gen_layer_hidden", size=hidden_dim,
+                      bias_attr=bias_attr, param_attr=param_attr,
+                      act=ReluActivation())
+    hidden2 = fc_layer(input=hidden, name="gen_hidden2", size=hidden_dim,
+                       bias_attr=bias_attr, param_attr=param_attr,
+                       act=LinearActivation())
+    hidden_bn = batch_norm_layer(
+        hidden2, act=ReluActivation(), name="gen_layer_hidden_bn",
+        bias_attr=bias_attr,
+        param_attr=ParamAttr(is_static=is_discriminator_training,
+                             initial_mean=1.0, initial_std=0.02),
+        use_global_stats=False)
+    return fc_layer(input=hidden_bn, name="gen_layer1", size=sample_dim,
+                    bias_attr=bias_attr, param_attr=param_attr,
+                    act=LinearActivation())
+
+
+if is_generator_training:
+    noise = data_layer(name="noise", size=noise_dim)
+    sample = generator(noise)
+
+if is_discriminator_training:
+    sample = data_layer(name="sample", size=sample_dim)
+
+if is_generator_training or is_discriminator_training:
+    label = data_layer(name="label", type=integer_value(2))
+    prob = discriminator(sample)
+    cost = cross_entropy(input=prob, label=label)
+    outputs(cost)
+
+if is_generator:
+    noise = data_layer(name="noise", size=noise_dim)
+    outputs(generator(noise))
+
+if is_discriminator:
+    sample = data_layer(name="sample", size=sample_dim)
+    outputs(discriminator(sample))

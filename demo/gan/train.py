@@ -1,0 +1,161 @@
+"""GAN demo — alternating two-network training on synthetic 2-D data.
+
+Reference: ``v1_api_demo/gan/gan_trainer.py``.  The reference builds
+three SWIG GradientMachines from one config (generator_training,
+discriminator_training, generator) and hand-copies shared parameters
+between them (``copy_shared_parameters``); which net trains each batch
+is chosen by comparing current losses, with a 3-batch strike cap.
+
+TPU-native translation: the two *training* topologies are two
+:class:`Trainer`s whose parameter dicts intersect by name; the frozen
+half of each net is ``ParamAttr(is_static=...)`` (lr scale 0 — the
+update is a no-op inside the same jitted step).  Fake samples come from
+the generator-training net itself via an output-pruned forward
+(``only=``), so no third machine is needed.
+
+Run: python demo/gan/train.py [--batches N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+CONF = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "gan_conf.py")
+
+
+def copy_shared_parameters(src, dst) -> None:
+    """``gan_trainer.py copy_shared_parameters``: value copy for every
+    parameter name both machines know."""
+    import jax.numpy as jnp
+    for name in dst.params:
+        if name in src.params:
+            dst.params[name] = jnp.asarray(src.params[name])
+    for name in dst.buffers:                      # batch-norm stats too
+        if name in src.buffers:
+            dst.buffers[name] = jnp.asarray(src.buffers[name])
+
+
+def load_uniform_data(n=100000, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n, 2).astype(np.float32) * 2.0 - 1.0)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", type=int, default=300,
+                        help="total training batches")
+    parser.add_argument("--batch_size", type=int, default=128)
+    args = parser.parse_args(argv)
+
+    from paddle_tpu.config.config_parser import parse_config
+    from paddle_tpu.core.sequence import value_of
+    from paddle_tpu.layers import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+    import jax.numpy as jnp
+
+    gen_model, gen_opt, _ = parse_config(CONF, "mode=generator_training")
+    dis_model, dis_opt, _ = parse_config(CONF, "mode=discriminator_training")
+    gen_net = NeuralNetwork(gen_model)
+    dis_net = NeuralNetwork(dis_model)
+    gen_trainer = Trainer(gen_net, opt_config=gen_opt, seed=1)
+    dis_trainer = Trainer(dis_net, opt_config=dis_opt, seed=2)
+    # start from ONE weight set (reference inits both from gen machine)
+    copy_shared_parameters(gen_trainer, dis_trainer)
+
+    sample_layer = "gen_layer1"   # generator output inside the gen net
+    noise_dim = gen_model.find_layer("noise").size
+    bs = args.batch_size
+    data = load_uniform_data()
+    rng = np.random.RandomState(7)
+
+    def get_noise():
+        return jnp.asarray(
+            rng.normal(size=(bs, noise_dim)).astype(np.float32))
+
+    def get_real():
+        idx = rng.choice(data.shape[0], bs, replace=False)
+        return jnp.asarray(data[idx])
+
+    def fake_samples(noise):
+        vals, _ = gen_net.forward(gen_trainer.params, {"noise": noise},
+                                  gen_trainer.buffers, is_training=False,
+                                  only=[sample_layer])
+        return value_of(vals[sample_layer])
+
+    def dis_loss(sample, label):
+        vals, _ = dis_net.forward(
+            dis_trainer.params, {"sample": sample, "label": label},
+            dis_trainer.buffers, is_training=False)
+        return float(np.mean(np.asarray(value_of(
+            vals[dis_net.output_names[0]]))))
+
+    def gen_loss(noise):
+        vals, _ = gen_net.forward(
+            gen_trainer.params,
+            {"noise": noise, "label": jnp.ones((bs,), jnp.int32)},
+            gen_trainer.buffers, is_training=False)
+        return float(np.mean(np.asarray(value_of(
+            vals[gen_net.output_names[0]]))))
+
+    ones = jnp.ones((bs,), jnp.int32)
+    zeros = jnp.zeros((bs,), jnp.int32)
+    curr_train = "dis"
+    curr_strike = 0
+    MAX_STRIKE = 3
+    first = {"d": None, "g": None}
+    last = {"d": None, "g": None}
+
+    for it in range(args.batches):
+        noise = get_noise()
+        d_pos = dis_loss(get_real(), ones)
+        d_neg = dis_loss(fake_samples(noise), zeros)
+        d_loss = 0.5 * (d_pos + d_neg)
+        g_loss = gen_loss(noise)
+        if first["d"] is None:
+            first["d"], first["g"] = d_loss, g_loss
+        last["d"], last["g"] = d_loss, g_loss
+        if it % 50 == 0:
+            print(f"batch {it}: d_loss={d_loss:.4f} g_loss={g_loss:.4f} "
+                  f"training={curr_train}")
+
+        # reference schedule: train whichever net is losing, strike-capped
+        if (not (curr_train == "dis" and curr_strike == MAX_STRIKE)) and \
+                (curr_train == "gen" and curr_strike == MAX_STRIKE or
+                 d_loss > g_loss):
+            if curr_train == "dis":
+                curr_strike += 1
+            else:
+                curr_train, curr_strike = "dis", 1
+            if rng.rand() < 0.5:
+                dis_trainer.train_one_batch(
+                    {"sample": fake_samples(get_noise()), "label": zeros})
+            else:
+                dis_trainer.train_one_batch(
+                    {"sample": get_real(), "label": ones})
+            copy_shared_parameters(dis_trainer, gen_trainer)
+        else:
+            if curr_train == "gen":
+                curr_strike += 1
+            else:
+                curr_train, curr_strike = "gen", 1
+            gen_trainer.train_one_batch(
+                {"noise": get_noise(), "label": ones})
+            copy_shared_parameters(gen_trainer, dis_trainer)
+
+    fake = np.asarray(fake_samples(get_noise()))
+    print(f"final: d_loss {first['d']:.4f}->{last['d']:.4f}, "
+          f"g_loss {first['g']:.4f}->{last['g']:.4f}")
+    print(f"generated mean={fake.mean(0)}, std={fake.std(0)} "
+          f"(real: mean~0, std~0.577)")
+    ok = (np.isfinite(last["d"]) and np.isfinite(last["g"])
+          and last["g"] != first["g"] and last["d"] != first["d"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+# Bag-of-words data provider (reference
+# ``v1_api_demo/quick_start/dataprovider_bow.py``): each comment becomes a
+# sparse binary vector over the dictionary; label is the category id.
+from paddle_tpu.data.provider import CacheType, provider
+from paddle_tpu.data.feeder import integer_value, sparse_binary_vector
+
+UNK_IDX = 0
+
+
+def initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = {
+        "word": sparse_binary_vector(len(dictionary)),
+        "label": integer_value(2),
+    }
+
+
+@provider(init_hook=initializer, cache=CacheType.CACHE_PASS_IN_MEM)
+def process(settings, file_name):
+    with open(file_name) as f:
+        for line in f:
+            label, comment = line.strip().split("\t")
+            words = comment.split()
+            word_vector = [settings.word_dict.get(w, UNK_IDX)
+                           for w in words]
+            yield {"word": word_vector, "label": int(label)}
+
+
+@provider(init_hook=initializer, should_shuffle=False)
+def process_predict(settings, file_name):
+    with open(file_name) as f:
+        for line in f:
+            comment = line.strip().split("\t")[-1]
+            word_vector = [settings.word_dict.get(w, UNK_IDX)
+                           for w in comment.split()]
+            yield {"word": word_vector}

@@ -1,0 +1,37 @@
+# Word-sequence data provider (reference
+# ``v1_api_demo/quick_start/dataprovider_emb.py``): word id sequences for
+# embedding + recurrent configs.
+from paddle_tpu.data.provider import CacheType, provider
+from paddle_tpu.data.feeder import integer_value, integer_value_sequence
+
+UNK_IDX = 0
+
+
+def initializer(settings, dictionary, **kwargs):
+    settings.word_dict = dictionary
+    settings.input_types = {
+        "word": integer_value_sequence(len(dictionary)),
+        "label": integer_value(2),
+    }
+
+
+@provider(init_hook=initializer, cache=CacheType.CACHE_PASS_IN_MEM)
+def process(settings, file_name):
+    with open(file_name) as f:
+        for line in f:
+            label, comment = line.strip().split("\t")
+            word_slot = [settings.word_dict.get(w, UNK_IDX)
+                         for w in comment.split()]
+            if word_slot:
+                yield {"word": word_slot, "label": int(label)}
+
+
+@provider(init_hook=initializer, should_shuffle=False)
+def process_predict(settings, file_name):
+    with open(file_name) as f:
+        for line in f:
+            comment = line.strip().split("\t")[-1]
+            word_slot = [settings.word_dict.get(w, UNK_IDX)
+                         for w in comment.split()]
+            if word_slot:
+                yield {"word": word_slot}

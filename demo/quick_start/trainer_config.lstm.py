@@ -1,0 +1,46 @@
+# LSTM text classifier over word-id sequences
+# (reference ``v1_api_demo/quick_start/trainer_config.lstm.py``).
+import os
+
+from paddle_tpu.config.config_parser import *
+
+_here = os.path.dirname(os.path.abspath(__file__))
+dict_file = os.path.join(_here, "data", "dict.txt")
+word_dict = dict()
+with open(dict_file) as f:
+    for i, line in enumerate(f):
+        w = line.strip().split()[0]
+        word_dict[w] = i
+
+is_predict = get_config_arg("is_predict", bool, False)
+trn = os.path.join(_here, "data/train.list") if not is_predict else None
+tst = os.path.join(_here, "data/test.list")
+
+define_py_data_sources2(
+    train_list=trn,
+    test_list=tst,
+    module="dataprovider_emb",
+    obj="process" if not is_predict else "process_predict",
+    args={"dictionary": word_dict})
+
+batch_size = get_config_arg("batch_size", int, 64 if not is_predict else 1)
+settings(
+    batch_size=batch_size,
+    learning_rate=2e-3,
+    learning_method=AdamOptimizer(),
+    regularization=L2Regularization(8e-4),
+    gradient_clipping_threshold=25)
+
+data = data_layer(name="word", size=len(word_dict))
+emb = embedding_layer(input=data, size=32)
+lstm = simple_lstm(input=emb, size=32,
+                   lstm_cell_attr=ExtraAttr(drop_rate=0.25))
+lstm_max = pooling_layer(input=lstm, pooling_type=MaxPooling())
+output = fc_layer(input=lstm_max, size=2, act=SoftmaxActivation())
+if is_predict:
+    maxid = maxid_layer(output)
+    outputs([maxid, output])
+else:
+    label = data_layer(name="label", size=2)
+    cls = classification_cost(input=output, label=label)
+    outputs(cls)

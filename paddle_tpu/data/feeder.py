@@ -102,10 +102,14 @@ class DataFeeder:
         return row
 
     def convert(self, batch: List[Sequence]) -> Dict[str, Any]:
-        """minibatch (list of sample tuples) → feed dict."""
+        """minibatch (list of sample tuples OR dicts keyed by data-layer
+        name — both PyDataProvider2 sample conventions) → feed dict."""
         feed: Dict[str, Any] = {}
         for slot, (name, itype) in enumerate(self.feeding):
-            col = [self._materialize(sample[slot]) for sample in batch]
+            col = [self._materialize(sample[name]
+                                     if isinstance(sample, dict)
+                                     else sample[slot])
+                   for sample in batch]
             if itype.seq_level == 0:
                 if itype.kind == "index":
                     feed[name] = jnp.asarray(np.asarray(col, np.int32))

@@ -14,6 +14,7 @@ import random
 from typing import Any, Callable, Optional, Sequence
 
 from .feeder import InputType
+from ..compat import CacheType  # noqa: F401  (PyDataProvider2 name)
 
 
 class Settings:
