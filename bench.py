@@ -28,6 +28,7 @@ assumed 197 TFLOP/s bf16 peak (v5e).
 import argparse
 import json
 import time
+from functools import partial as _partial
 
 import jax
 import numpy as np
@@ -71,6 +72,66 @@ def _diff_time_ms(step_fn, warmup=3, iters=20, max_tries=3, tol=0.15):
     return best
 
 
+def _scan_time_ms(trainer, feed, iters=20, max_tries=3, tol=0.2):
+    """Device ms/step via K steps CHAINED INSIDE one jitted lax.scan.
+
+    The marginal-dispatch method (:func:`_diff_time_ms`) is at the mercy
+    of the axon tunnel's per-dispatch latency, which for small steps
+    (LSTM ~5 ms) is the same order as the step itself and varies run to
+    run.  Scanning K train steps inside one XLA program leaves exactly
+    one dispatch + one D2H sync per measurement; ms/step is the K-step
+    vs 1-step program difference divided by K-1.  ``timing_self_check``
+    is the relative spread of the warm K-step samples — tunnel/host
+    jitter shows up there, and the measurement retries on disagreement
+    or a non-positive difference.  The same batch is re-fed every step
+    (timing only; the per-step math is production-identical).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # build + place state exactly as train_one_batch would
+    trainer.train_one_batch(feed)
+    raw = trainer._raw_step
+    sfeed = trainer._shard_feed(feed)
+    rng = jax.random.PRNGKey(0)
+    progress = jnp.zeros((), jnp.float32)
+
+    def k_steps(k):
+        def body(carry, _):
+            p, o, b = carry
+            p, o, b, loss = raw(p, o, b, sfeed, rng, progress)
+            return (p, o, b), loss
+
+        @_partial(jax.jit, donate_argnums=(0, 1, 2))
+        def run(p, o, b):
+            (p, o, b), losses = lax.scan(body, (p, o, b), None, length=k)
+            return p, o, b, losses[-1]
+        return run
+
+    def samples(run, n=3):
+        def copy(t):
+            return jax.tree_util.tree_map(lambda x: x.copy(), t)
+        times = []
+        for _ in range(n):   # first sample pays the compile
+            p, o, b = (copy(trainer.params), copy(trainer.opt_state),
+                       copy(trainer.buffers))
+            t0 = time.perf_counter()
+            p, o, b, loss = run(p, o, b)
+            float(loss)
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return times[1:]     # warm samples only
+
+    one = min(samples(k_steps(1)))
+    for _ in range(max_tries):
+        warm = samples(k_steps(1 + iters))
+        ms = (min(warm) - one) / iters
+        spread = (max(warm) - min(warm)) / max(min(warm), 1e-3)
+        if ms > 0 and spread <= tol:
+            return ms, spread
+        one = min(one, min(samples(k_steps(1))))   # re-baseline
+    return max(ms, 1e-3), spread
+
+
 def _mk_trainer(cfg, lr=2e-3, clip=25.0, l2=0.0, mesh=None):
     from paddle_tpu.config.model_config import OptimizationConfig
     from paddle_tpu.layers.network import NeuralNetwork
@@ -111,12 +172,7 @@ def bench_lstm():
                     rng.randint(T // 2, T + 1, (B,)).astype(np.int32))),
             "label": jax.numpy.asarray(rng.randint(0, 2, (B,)).astype(np.int32))}
 
-    def step(sync):
-        loss = trainer.train_one_batch(feed)
-        if sync:
-            float(loss)
-
-    ms, agree = _diff_time_ms(step)
+    ms, agree = _scan_time_ms(trainer, feed)
     n = _n_chips(trainer)
     # fwd matmul FLOPs: layer1 x-proj [B,E]→[B,4H] + h-proj [B,H]→[B,4H],
     # layer2 both projections from H; per timestep, ×T
@@ -140,7 +196,7 @@ def bench_resnet():
     from paddle_tpu.data.feeder import dense_vector, integer_value
     from paddle_tpu.models.image import resnet
 
-    B, IMG, NCLASS = 64, 224, 1000
+    B, IMG, NCLASS = 128, 224, 1000  # 128 measured best/chip (64: 2483/s, 256: 2472/s)
     with config_scope():
         img = dsl.data("image", dense_vector(3 * IMG * IMG),
                        height=IMG, width=IMG)
@@ -156,20 +212,17 @@ def bench_resnet():
             "label": jax.numpy.asarray(
                 rng.randint(0, NCLASS, (B,)).astype(np.int32))}
 
-    def step(sync):
-        loss = trainer.train_one_batch(feed)
-        if sync:
-            float(loss)
-
-    ms, agree = _diff_time_ms(step, warmup=2, iters=10)
+    ms, agree = _scan_time_ms(trainer, feed, iters=8)
     n = _n_chips(trainer)
     sps_chip = B / (ms / 1e3) / n
-    fwd_flops_per_img = 3.8e9 * 2       # ~3.8 GMACs fwd @224²
+    # 3.858 GMACs fwd @224²: exact conv+fc MAC count of THIS config
+    # (summed from the parsed topology; the model is ResNet-50 v1)
+    fwd_flops_per_img = 3.858e9 * 2
     mfu = TRAIN_FLOP_FACTOR * fwd_flops_per_img * sps_chip / PEAK_FLOPS_BF16
     return {
         "metric": "resnet50_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
-        "unit": "samples/sec/chip (bs=64, 224x224, train step)",
+        "unit": f"samples/sec/chip (bs={B}, 224x224, train step)",
         "vs_baseline": round(sps_chip / 95.0, 3),  # published P40 fp32 ~95/s
         "mfu_est": round(mfu, 3),
         "devices": n,
@@ -177,18 +230,16 @@ def bench_resnet():
     }
 
 
-def bench_seq2seq():
-    # measured FASTER with fp32 activations (188k vs 150k tok/s): the
-    # attention group's per-step ops don't amortize the extra casts
-    FLAGS.set("bf16_activations", False)
+def seq2seq_setup(B=128, S_LEN=30, T_LEN=30, V=30000, E=512, H=512,
+                  bf16_activations=True):
+    """Build the seq2seq benchmark trainer + feed (shared by the bench
+    and the profiling harness)."""
+    FLAGS.set("bf16_activations", bf16_activations)
     from paddle_tpu.config import dsl
     from paddle_tpu.config.dsl import ParamAttr, StepInput, config_scope
     from paddle_tpu.core.sequence import SequenceBatch
     from paddle_tpu.data.feeder import integer_value_sequence
     from paddle_tpu.v2.networks import simple_attention, simple_gru
-
-    # B=128 measured best on v5e (64: 176k tok/s, 128: 228k, 256: 216k)
-    B, S_LEN, T_LEN, V, E, H = 128, 30, 30, 30000, 512, 512
 
     # the demo/seqToseq training topology at benchmark scale
     with config_scope():
@@ -239,13 +290,15 @@ def bench_seq2seq():
             jax.numpy.asarray(rng.randint(2, V, (B, T_LEN)).astype(np.int32)),
             jax.numpy.asarray(np.full((B,), T_LEN, np.int32))),
     }
+    return trainer, feed
 
-    def step_fn(sync):
-        loss = trainer.train_one_batch(feed)
-        if sync:
-            float(loss)
 
-    ms, agree = _diff_time_ms(step_fn, warmup=2, iters=10)
+def bench_seq2seq():
+    # B=128 measured best on v5e (64: 176k tok/s, 128: 228k, 256: 216k)
+    B, S_LEN, T_LEN, V, E, H = 128, 30, 30, 30000, 512, 512
+    trainer, feed = seq2seq_setup(B, S_LEN, T_LEN, V, E, H)
+
+    ms, agree = _scan_time_ms(trainer, feed, iters=16)
     n = _n_chips(trainer)
     tokens_per_sec = B * T_LEN / (ms / 1e3)
     # dominant matmuls fwd: encoder 2×GRU (3H gates from E and H) over
@@ -259,11 +312,11 @@ def bench_seq2seq():
         "metric": "seq2seq_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
         "unit": f"target tokens/sec (bs={B}, src=trg=30, hid=512, attn)",
-        # no in-tree reference number exists; yardstick = K40m 4-GPU
-        # LSTM hid=512 row (268 ms for 512×T=100 seqs ≈ 191k tok/s is
-        # unrealistic for attention seq2seq; we key off single-GPU
-        # hid=512 bs=256: 414 ms → 61.8k src tokens/s)
-        "vs_baseline": round(tokens_per_sec / 61800.0, 3),
+        # the reference never published a seq2seq number
+        # ("will be added later", benchmark/README.md:141); no yardstick
+        # is honest, so vs_baseline is intentionally absent — MFU is the
+        # comparable figure
+        "vs_baseline_note": "no published reference seq2seq number",
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),

@@ -91,7 +91,16 @@ class FullyConnectedLayer(Layer):
             y = _flat_apply(lambda v: math_ops.matmul(v, w), x)
             out = y if out is None else like(y, value_of(out) + value_of(y))
         if self.conf.with_bias:
-            out = map_value(lambda v: v + params[self.bias_name()], out)
+            # add in the activation dtype: promoting a bf16 [B,T,V]
+            # matmul output to f32 here costs a full convert+copy pass
+            # in BOTH directions (cast_layer_output re-casts right after)
+            out = map_value(
+                lambda v: v + params[self.bias_name()].astype(v.dtype), out)
+        if self.conf.active_type == "softmax" and self.conf.drop_rate == 0:
+            # expose pre-activation as '.logits' so classification costs
+            # can take the fused logits path (XLA dead-code-eliminates
+            # whichever output goes unused)
+            return {"out": self.finalize(out, ctx), "logits": out}
         return self.finalize(out, ctx)
 
 

@@ -55,9 +55,25 @@ def _masked_flatten_seq(x, label):
 
 @register_layer("multi-class-cross-entropy")
 class CrossEntropyCost(_CostBase):
+    _logits_value = None  # set by the network when the producer's
+    #                       '.logits' sub-output is available
+
     def forward(self, params, inputs, ctx):
-        x, label, mask = _masked_flatten_seq(inputs[0], inputs[1])
-        cost = loss_ops.cross_entropy(x, label.reshape(-1))
+        logits = self._logits_value
+        self._logits_value = None
+        if logits is not None:
+            # fused logits path: one pass fwd (logsumexp+gather), one
+            # bf16 pass bwd — see loss_ops.softmax_ce_fused.  Runs on
+            # the native [B, T, V] layout: flattening first costs a
+            # full-tensor relayout copy on TPU.
+            z = value_of(logits)
+            lab = value_of(inputs[1]).reshape(z.shape[:-1])
+            mask = logits.mask(jnp.float32).reshape(-1) \
+                if isinstance(logits, SequenceBatch) else None
+            cost = loss_ops.softmax_ce_fused(z, lab).reshape(-1)
+        else:
+            x, label, mask = _masked_flatten_seq(inputs[0], inputs[1])
+            cost = loss_ops.cross_entropy(x, label.reshape(-1))
         if mask is not None:
             cost = cost * mask
         return _per_example(self.weighted(cost, inputs), inputs[0])

@@ -93,6 +93,25 @@ class NeuralNetwork:
         self.output_names = config.output_layer_names or (
             [self.order[-1]] if self.order else [])
 
+        # classification-cost logits peephole: when a multi-class CE
+        # cost reads a softmax-activated fc, route it the layer's
+        # '.logits' sub-output so the fused logits-CE path can run (the
+        # softmax output is then dead in training and XLA removes it)
+        lmap = config.layer_map()
+        self._cost_logit_alias: Dict[str, str] = {}
+        for cname in self.cost_layers:
+            lyr = self.layers[cname]
+            if lyr.conf.type != "multi-class-cross-entropy" \
+                    or not lyr.conf.inputs:
+                continue
+            pname = lyr.conf.inputs[0].input_layer_name
+            pconf = lmap.get(pname)
+            if pconf is not None \
+                    and pconf.type in ("fc", "mkldnn_fc") \
+                    and pconf.active_type == "softmax" \
+                    and pconf.drop_rate == 0:
+                self._cost_logit_alias[cname] = pname + ".logits"
+
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:
             for spec in layer.param_specs():
@@ -216,6 +235,11 @@ class NeuralNetwork:
                     if iname not in values:
                         self._run_producer(iname, params, values, ctx, done_groups)
                     inputs.append(values[iname])
+                if name in self._cost_logit_alias:
+                    # hand the cost its producer's logits when the graph
+                    # exposed them (None → cost falls back to probs)
+                    layer._logits_value = values.get(
+                        self._cost_logit_alias[name])
                 out = cast_layer_output(layer, layer.forward(params, inputs, ctx))
             if isinstance(out, dict):
                 for k, v in out.items():

@@ -62,18 +62,43 @@ class RecurrentGroup:
                 return head
         return None
 
+    # Layer types whose forward is pointwise over leading axes (operate
+    # on the trailing feature dim only), so running them once on a
+    # stacked [B, T, ...] SequenceBatch is identical to running them
+    # per-frame.  Sequence-aware types (pooling, last_seq, expand,
+    # concat, ...) must NOT be hoisted — on a stacked batch they would
+    # reduce over time.
+    POINTWISE_TYPES = frozenset({
+        "fc", "mkldnn_fc", "mixed", "addto", "scaling", "clip",
+        "slope_intercept", "power", "get_output", "maxid", "print",
+        "dot_prod", "interpolation",
+    })
+    # projection/operator types inside a mixed layer that look across
+    # the time axis — a mixed layer carrying one is NOT pointwise
+    _SEQ_PROJ_TYPES = frozenset({"context", "conv", "convt"})
+
+    def _is_pointwise(self, conf) -> bool:
+        if conf.type not in self.POINTWISE_TYPES:
+            return False
+        if conf.type == "mixed":
+            for inp in conf.inputs:
+                proj = getattr(inp, "proj", None)
+                if proj is not None and proj.type in self._SEQ_PROJ_TYPES:
+                    return False
+        return True
+
     def _split_scan_epilogue(self) -> Tuple[set, List[str]]:
         """Split the step layers into (scan set, hoisted suffix).
 
         A layer must run inside the scan iff a memory depends on it
-        (transitively).  Everything else is time-pointwise — its frame-t
-        output never feeds frame t+1 — so it can run AFTER the scan,
-        vmapped over the stacked time axis.  XLA then batches the hoisted
-        matmuls over T*B instead of issuing T sequential ones; for
-        decoder output projections ([B,H]×[H,V] per step, V≫H) this is
-        the difference between MXU-bound and latency-bound.  This is an
-        optimization the reference's step-by-step
-        ``RecurrentGradientMachine.cpp`` cannot express.
+        (transitively) or its type is not time-pointwise.  Everything
+        else can run AFTER the scan, once, over the whole stacked time
+        axis.  XLA then batches the hoisted matmuls over T*B instead of
+        issuing T sequential ones; for decoder output projections
+        ([B,H]×[H,V] per step, V≫H) this is the difference between
+        MXU-bound and latency-bound.  This is an optimization the
+        reference's step-by-step ``RecurrentGradientMachine.cpp`` cannot
+        express.
         """
         # memories may bind a dict sub-output ("lstm_out.state"): seed
         # with the PRODUCER layer, not the raw value name
@@ -85,6 +110,9 @@ class RecurrentGroup:
                     f"group {self.sub.name}: memory layer "
                     f"{m['layer_name']!r} is not produced by the group")
             need.add(p)
+        for n in self.order:      # non-pointwise layers stay in the scan
+            if not self._is_pointwise(self.layers[n].conf):
+                need.add(n)
         changed = True
         while changed:
             changed = False
@@ -248,29 +276,50 @@ class RecurrentGroup:
         inp["__mask__"] = m_t
         _, stacked = jax.lax.scan(scan_fn, mems0, inp)
 
-        if hoisted:
-            # run the time-pointwise suffix over the whole stacked time
-            # axis at once: vmap over T batches the per-step matmuls into
-            # single MXU-sized ones (decoder softmax projections etc.)
-            def epilogue(frame):
-                vals = dict(frame)
-                self._forward_layers(hoisted, vals, outer, params, ctx)
-                return {o: value_of(vals[o]) for o in hoist_outs}
-
-            epi_in = {bname: stacked["__b__" + bname] for bname in boundary}
-            for l in frames_used:
-                epi_in[l] = xs[l]
-            epi_stacked = jax.vmap(epilogue)(epi_in)
-            for o in hoist_outs:
-                d = epi_stacked[o]
-                mb = (m_t > 0).reshape(m_t.shape + (1,) * (d.ndim - 2))
-                stacked[o] = jnp.where(mb, d, jnp.zeros((), d.dtype))
-
-        for o in self.out_links:
+        for o in scan_outs:
             data = jnp.moveaxis(stacked[o], 0, 1)  # [B, T, ...]
             if self.sub.reversed:
                 data = data[:, ::-1]
             values[o] = SequenceBatch(data=data, length=length)
+
+        if hoisted:
+            # Run the time-pointwise suffix ONCE over the whole stacked
+            # sequence, as ordinary [B, T, ...] SequenceBatch layers in
+            # batch-major layout.  The boundary tensors crossing the
+            # scan→epilogue cut are small ([T, B, H] hidden states); the
+            # big epilogue products (decoder softmax projections,
+            # [B, T, V]) are produced directly in their consumer layout —
+            # profiling showed the old per-frame vmap forced a [T, B, V]
+            # stack + transpose + reshape worth ~20% of the seq2seq step.
+            vals: Dict[str, Any] = {}
+            for bname in boundary:
+                d = stacked.pop("__b__" + bname)
+                if self.sub.reversed:
+                    d = d[::-1]
+                vals[bname] = SequenceBatch(data=jnp.moveaxis(d, 0, 1),
+                                            length=length)
+            for l in frames_used:
+                vals[l] = values[l] if isinstance(values[l], SequenceBatch) \
+                    else SequenceBatch(data=jnp.moveaxis(xs[l], 0, 1),
+                                       length=length)
+            self._forward_layers(hoisted, vals, outer, params, ctx)
+            mask2 = mask > 0                       # [B, T]
+            for o in hoist_outs:
+                v = vals[o]
+                d = value_of(v)
+                mb = mask2.reshape(mask2.shape + (1,) * (d.ndim - 2))
+                d = jnp.where(mb, d, jnp.zeros((), d.dtype))
+                values[o] = SequenceBatch(data=d, length=length)
+            # expose dict sub-outputs of hoisted out-link producers
+            # (e.g. 'dec_prob.logits' for the fused-CE peephole);
+            # unmasked — consumers mask by length themselves
+            outp = {self._producer_of(o) or o for o in hoist_outs}
+            for k, v in vals.items():
+                if "." in k and k.split(".", 1)[0] in outp \
+                        and k not in values:
+                    d = value_of(v)
+                    values[k] = SequenceBatch(data=d, length=length) \
+                        if d.ndim >= 2 and d.shape[:2] == (b, t) else v
 
     def _run_nested(self, params: Dict[str, jax.Array],
                     values: Dict[str, Any], ctx: ForwardContext) -> None:

@@ -14,6 +14,8 @@ reference's NCHW configs are converted at the layer-engine boundary.
 
 from __future__ import annotations
 
+from functools import partial as _partial
+
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -218,6 +220,87 @@ def spatial_pyramid_pool(x, pyramid_height: int, pool_type: str = "max"):
     return jnp.concatenate(outs, axis=-1).reshape(n, -1)
 
 
+def _bn_axes(ndim: int, data_format: str) -> Tuple[Tuple[int, ...], int]:
+    c_ax = ndim - 1 if data_format.endswith("C") else 1
+    return tuple(i for i in range(ndim) if i != c_ax), c_ax
+
+
+def _bn_apply(x, scale, bias, m, inv, c_ax):
+    """One fused multiply-add pass in x's dtype with the per-channel
+    scale/offset folded."""
+    shape = [1] * x.ndim
+    shape[c_ax] = x.shape[c_ax]
+    a = (inv * scale).astype(x.dtype).reshape(shape)
+    b = (bias - m * inv * scale).astype(x.dtype).reshape(shape)
+    return x * a + b
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train(x, scale, bias, eps, axes, c_ax):
+    (y, _stats), _res = _bn_train_fwd(x, scale, bias, eps, axes, c_ax)
+    return y
+
+
+def _bn_stats(x, axes):
+    m = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    # square in fp32: the upcast happens in-register on the same bf16
+    # read, and a bf16 x*x loses all low bits when |mean| >> std,
+    # collapsing the E[x²]−E[x]² difference to 0
+    m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+    v = jnp.maximum(m2 - m * m, 0.0)
+    return m, v
+
+
+def _bn_train_fwd(x, scale, bias, eps, axes, c_ax):
+    m, v = _bn_stats(x, axes)
+    inv = lax.rsqrt(v + eps)
+    y = _bn_apply(x, scale, bias, m, inv, c_ax)
+    return (y, (m, v)), (x, scale, m, inv)
+
+
+def _bn_train_bwd(eps, axes, c_ax, res, cts):
+    """Hand-fused BN backward (the cuDNN ``BatchNormBackward`` formula):
+
+        dbias  = Σ dy
+        dscale = Σ dy·x̂
+        dx     = scale·inv · (dy − dbias/N − x̂·dscale/N)
+
+    ONE fused reduction pass over (dy, x) for both sums + one apply pass
+    — autodiff through the E[x²] stats path emits twice the reduction
+    traffic, which profiling showed as ~18% of the ResNet train step
+    ("convert_reduce" loop fusions).  Stats cotangents (running-average
+    buffers) are dropped: buffers are side-channel state with
+    stop-gradient semantics, as in the reference
+    (``BatchNormalizationLayer`` never backprops moving averages).
+    """
+    dy, _ = cts
+    x, scale, m, inv = res
+    shape = [1] * x.ndim
+    shape[c_ax] = x.shape[c_ax]
+    n = np.prod([x.shape[i] for i in axes]).astype(np.float32)
+    xhat_f = (x.astype(jnp.float32) - m.reshape(shape)) * inv.reshape(shape)
+    dy_f = dy.astype(jnp.float32)
+    dbias = jnp.sum(dy_f, axis=axes)
+    dscale = jnp.sum(dy_f * xhat_f, axis=axes)
+    coeff = (scale * inv).astype(jnp.float32).reshape(shape)
+    dx = coeff * (dy_f - (dbias / n).reshape(shape)
+                  - xhat_f * (dscale / n).reshape(shape))
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            dbias.astype(scale.dtype))
+
+
+def _bn_train_y_fwd(x, scale, bias, eps, axes, c_ax):
+    (y, _stats), res = _bn_train_fwd(x, scale, bias, eps, axes, c_ax)
+    return y, res
+
+
+def _bn_train_y_bwd(eps, axes, c_ax, res, dy):
+    return _bn_train_bwd(eps, axes, c_ax, res, (dy, None))
+
+
+_bn_train.defvjp(_bn_train_y_fwd, _bn_train_y_bwd)
+
+
 @register_op("batch_norm", n_outputs=3)
 def batch_norm(x, scale, bias, running_mean, running_var,
                momentum: float = 0.9, eps: float = 1e-5,
@@ -229,29 +312,22 @@ def batch_norm(x, scale, bias, running_mean, running_var,
     READ in its own dtype (one pass, E[x²]−E[x]² with fp32 accumulators)
     and the normalization is a single multiply-add in x's dtype with the
     per-channel scale/offset folded — under bf16 activations this halves
-    BN's HBM traffic, which dominates ResNet-class steps (measured: BN at
-    ~1/3 of the fp32-pass train step).
+    BN's HBM traffic, which dominates ResNet-class steps.  Training mode
+    uses a hand-fused custom-VJP backward (see :func:`_bn_train_bwd`).
     """
-    axes = tuple(i for i in range(x.ndim) if i != (x.ndim - 1 if data_format.endswith("C") else 1))
+    axes, c_ax = _bn_axes(x.ndim, data_format)
     if is_training:
-        m = jnp.mean(x, axis=axes, dtype=jnp.float32)
-        # square in fp32: the upcast happens in-register on the same bf16
-        # read, and a bf16 x*x loses all low bits when |mean| >> std,
-        # collapsing the E[x²]−E[x]² difference to 0
-        m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
-        v = jnp.maximum(m2 - m * m, 0.0)
+        # stats recomputed outside the custom_vjp for the running
+        # averages (cheap per-channel math; XLA CSEs the reduction with
+        # the one inside _bn_train's forward)
+        m, v = _bn_stats(x, axes)
+        y = _bn_train(x, scale, bias, eps, axes, c_ax)
         new_rm = momentum * running_mean + (1 - momentum) * m
         new_rv = momentum * running_var + (1 - momentum) * v
-    else:
-        m, v = running_mean, running_var
-        new_rm, new_rv = running_mean, running_var
-    shape = [1] * x.ndim
-    c_ax = x.ndim - 1 if data_format.endswith("C") else 1
-    shape[c_ax] = x.shape[c_ax]
-    inv = lax.rsqrt(v + eps)
-    a = (inv * scale).astype(x.dtype).reshape(shape)
-    b = (bias - m * inv * scale).astype(x.dtype).reshape(shape)
-    return x * a + b, new_rm, new_rv
+        return y, new_rm, new_rv
+    inv = lax.rsqrt(running_var + eps)
+    y = _bn_apply(x, scale, bias, running_mean, inv, c_ax)
+    return y, running_mean, running_var
 
 
 @register_op("lrn")

@@ -194,6 +194,7 @@ class Trainer:
                                             lr_scales, sparse_masks=masks)
             return new_params, new_opt, new_buffers, loss
 
+        self._raw_step = step   # unjitted; benchmarks scan over it
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _eval_output_names(self) -> List[str]:
