@@ -13,10 +13,56 @@ from __future__ import annotations
 
 import gzip
 import os
+import pickle
+import re
+import string
 import struct
-from typing import Callable, Iterator, Tuple
+import tarfile
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
+
+from .download import DownloadError, download
+from ..utils import get_logger
+
+log = get_logger("dataset")
+
+# Official corpus URLs + md5s (python/paddle/v2/dataset/*.py constants)
+MNIST_URL_PREFIX = "http://yann.lecun.com/exdb/mnist/"
+MNIST_MD5 = {
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+    "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+    "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+}
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+IMDB_URL = "http://ai.stanford.edu/%7Eamaas/data/sentiment/aclImdb_v1.tar.gz"
+IMDB_MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+UCI_HOUSING_URL = ("https://archive.ics.uci.edu/ml/machine-learning-"
+                   "databases/housing/housing.data")
+UCI_HOUSING_MD5 = "d4accdce7a25600298819f8e28e8d593"
+WMT14_TRAIN_URL = ("http://paddlepaddle.cdn.bcebos.com/demo/"
+                   "wmt_shrinked_data/wmt14.tgz")
+WMT14_TRAIN_MD5 = "0791583d57d5beb693b9414c5b36798c"
+
+
+_download_failed: set = set()
+
+
+def _try_download(url: str, module: str, md5: str) -> Optional[str]:
+    """Cached-or-downloaded path, or None (loaders then fall back to
+    their synthetic surrogate).  A failed URL is not retried within the
+    process — readers re-run every pass."""
+    if url in _download_failed:
+        return None
+    try:
+        return download(url, module, md5)
+    except DownloadError as e:
+        log.warning("%s unavailable (%s); using synthetic surrogate",
+                    module, e)
+        _download_failed.add(url)
+        return None
 
 CACHE_ROOT = os.path.expanduser(
     os.environ.get("PADDLE_TPU_DATASET_CACHE", "~/.cache/paddle/dataset"))
@@ -55,10 +101,19 @@ def _read_idx_labels(path: str) -> np.ndarray:
         return np.frombuffer(f.read(), np.uint8).astype(np.int64)
 
 
+def _mnist_paths(img_name, lab_name):
+    img_p = _cache_path("mnist", img_name)
+    lab_p = _cache_path("mnist", lab_name)
+    if not (os.path.exists(img_p) and os.path.exists(lab_p)):
+        for name in (img_name, lab_name):
+            _try_download(MNIST_URL_PREFIX + name, "mnist", MNIST_MD5[name])
+    return img_p, lab_p
+
+
 def mnist_train(n_synth: int = 8192):
     """Reader of (image[784] in [-1,1], label) — ``v2/dataset/mnist.py``."""
-    img_p = _cache_path("mnist", "train-images-idx3-ubyte.gz")
-    lab_p = _cache_path("mnist", "train-labels-idx1-ubyte.gz")
+    img_p, lab_p = _mnist_paths("train-images-idx3-ubyte.gz",
+                                "train-labels-idx1-ubyte.gz")
 
     def reader():
         if os.path.exists(img_p) and os.path.exists(lab_p):
@@ -72,8 +127,8 @@ def mnist_train(n_synth: int = 8192):
 
 
 def mnist_test(n_synth: int = 1024):
-    img_p = _cache_path("mnist", "t10k-images-idx3-ubyte.gz")
-    lab_p = _cache_path("mnist", "t10k-labels-idx1-ubyte.gz")
+    img_p, lab_p = _mnist_paths("t10k-images-idx3-ubyte.gz",
+                                "t10k-labels-idx1-ubyte.gz")
 
     def reader():
         if os.path.exists(img_p) and os.path.exists(lab_p):
@@ -86,28 +141,160 @@ def mnist_test(n_synth: int = 1024):
     return reader
 
 
+
+
+# ----------------------------------------------------- real-corpus parsers
+# Each takes LOCAL file paths (unit-tested on bundled tiny fixtures); the
+# public loaders below wire them to the download cache with synthetic
+# fallback.  Formats match the reference parsers exactly
+# (``python/paddle/v2/dataset/{cifar,imdb,uci_housing,wmt14}.py``).
+
+def parse_cifar(tar_path: str, sub_name: str
+                ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Yield (image[3072] float in [0,1] CHW, label) from a CIFAR python
+    tarball (pickled batches; ``cifar.py:46`` reads b'data' +
+    b'labels'/b'fine_labels')."""
+    with tarfile.open(tar_path, mode="r") as f:
+        names = sorted(m.name for m in f if sub_name in m.name)
+        for name in names:
+            batch = pickle.load(f.extractfile(name), encoding="bytes")
+            data = batch[b"data"]
+            labels = batch.get(b"labels", batch.get(b"fine_labels"))
+            assert labels is not None
+            for sample, label in zip(data, labels):
+                yield (sample / 255.0).astype(np.float32), int(label)
+
+
+def imdb_tokenize(tar_path: str, pattern: "re.Pattern"
+                  ) -> Iterator[list]:
+    """Tokenized docs from the aclImdb tarball (``imdb.py:38``:
+    punctuation stripped, lowercased, whitespace split; sequential
+    tarfile.next() access)."""
+    table = str.maketrans("", "", string.punctuation)
+    with tarfile.open(tar_path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                text = tarf.extractfile(tf).read().decode(
+                    "utf-8", errors="ignore")
+                yield text.rstrip("\n\r").translate(table).lower().split()
+            tf = tarf.next()
+
+
+def imdb_build_dict(tar_path: str, pattern_str: str, cutoff: int = 150
+                    ) -> Dict[str, int]:
+    """Frequency-sorted word dict with trailing <unk> (``imdb.py:62``)."""
+    import collections
+    word_freq: Dict[str, int] = collections.defaultdict(int)
+    for doc in imdb_tokenize(tar_path, re.compile(pattern_str)):
+        for word in doc:
+            word_freq[word] += 1
+    items = [x for x in word_freq.items() if x[1] > cutoff]
+    items.sort(key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def parse_imdb(tar_path: str, pos_pattern: str, neg_pattern: str,
+               word_idx: Dict[str, int]
+               ) -> Iterator[Tuple[list, int]]:
+    """Yield (word_ids, label) pairs, label 0=positive 1=negative as the
+    reference encodes them (``imdb.py:91``: pos first, label 0)."""
+    unk = word_idx["<unk>"]
+    for label, pat in ((0, pos_pattern), (1, neg_pattern)):
+        for doc in imdb_tokenize(tar_path, re.compile(pat)):
+            yield [word_idx.get(w, unk) for w in doc], label
+
+
+def parse_uci_housing(path: str, feature_num: int = 14, ratio: float = 0.8
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """(train, test) arrays, features mean-centered and range-scaled
+    (``uci_housing.py:57`` load_data, 80/20 split)."""
+    data = np.fromfile(path, sep=" ")
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums = data.max(axis=0)
+    minimums = data.min(axis=0)
+    avgs = data.sum(axis=0) / data.shape[0]
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    return data[:offset], data[offset:]
+
+
+def wmt14_read_dicts(tar_path: str, dict_size: int
+                     ) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """(src_dict, trg_dict) from the wmt14 tarball's src.dict/trg.dict
+    members (``wmt14.py:45`` __read_to_dict__)."""
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.strip().decode("utf-8", errors="ignore")] = i
+        return out
+
+    with tarfile.open(tar_path, mode="r") as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        assert len(src_name) == 1 and len(trg_name) == 1
+        src = to_dict(f.extractfile(src_name[0]), dict_size)
+        trg = to_dict(f.extractfile(trg_name[0]), dict_size)
+    return src, trg
+
+
+def parse_wmt14(tar_path: str, file_name: str, dict_size: int,
+                max_len: int = 80) -> Iterator[Tuple[list, list, list]]:
+    """Yield (src_ids, trg_ids_with_<s>, trg_next_ids) triples
+    (``wmt14.py:72`` reader_creator: <s>/<e> wrapping, UNK id 2,
+    sentences over ``max_len`` dropped)."""
+    src_dict, trg_dict = wmt14_read_dicts(tar_path, dict_size)
+    start_tok, end_tok = "<s>", "<e>"
+    with tarfile.open(tar_path, mode="r") as f:
+        names = [m.name for m in f if m.name.endswith(file_name)]
+        for name in names:
+            for line in f.extractfile(name):
+                parts = line.decode("utf-8", errors="ignore").strip() \
+                    .split("\t")
+                if len(parts) != 2:
+                    continue
+                src_words = [start_tok] + parts[0].split() + [end_tok]
+                src_ids = [src_dict.get(w, UNK) for w in src_words]
+                trg_words = parts[1].split()
+                trg_ids = [trg_dict.get(w, UNK) for w in trg_words]
+                if len(src_ids) > max_len or len(trg_ids) > max_len:
+                    continue
+                trg_next = trg_ids + [trg_dict[end_tok]]
+                trg_ids = [trg_dict[start_tok]] + trg_ids
+                yield src_ids, trg_ids, trg_next
+
+
 # --------------------------------------------------------------------- cifar
+
+def _cifar_reader(sub_name: str, n_synth: int, seed: int):
+    # resolved ONCE (download() md5-hashes the tarball; per-epoch would
+    # re-hash 163MB every pass)
+    tar = _try_download(CIFAR10_URL, "cifar", CIFAR10_MD5)
+
+    def reader():
+        if tar:
+            yield from parse_cifar(tar, sub_name)
+            return
+        imgs, labs = _synthetic_images(n_synth, 32, 10, seed=seed)
+        imgs3 = np.repeat(imgs, 3, axis=1)[:, : 3 * 32 * 32]
+        for i in range(len(labs)):
+            yield imgs3[i], int(labs[i])
+
+    return reader
+
 
 def cifar10_train(n_synth: int = 4096):
     """Reader of (image[3072] CHW float, label) — ``v2/dataset/cifar.py``."""
-
-    def reader():
-        imgs, labs = _synthetic_images(n_synth, 32, 10, seed=9)
-        imgs3 = np.repeat(imgs, 3, axis=1)[:, : 3 * 32 * 32]
-        for i in range(len(labs)):
-            yield imgs3[i], int(labs[i])
-
-    return reader
+    return _cifar_reader("data_batch", n_synth, seed=9)
 
 
 def cifar10_test(n_synth: int = 512):
-    def reader():
-        imgs, labs = _synthetic_images(n_synth, 32, 10, seed=10)
-        imgs3 = np.repeat(imgs, 3, axis=1)[:, : 3 * 32 * 32]
-        for i in range(len(labs)):
-            yield imgs3[i], int(labs[i])
-
-    return reader
+    return _cifar_reader("test_batch", n_synth, seed=10)
 
 
 # ---------------------------------------------------------------------- imdb
@@ -131,25 +318,36 @@ def _synthetic_text(n: int, vocab: int, classes: int, min_len: int,
 
 
 def imdb_word_dict(vocab: int = 5148):
+    """Real corpus dict when available (``imdb.py`` build_dict over the
+    train split, cutoff 150), else a synthetic stand-in."""
+    tar = _try_download(IMDB_URL, "imdb", IMDB_MD5)
+    if tar:
+        return imdb_build_dict(
+            tar, "aclImdb/((train)|(test))/((pos)|(neg))/.*\\.txt$", 150)
     return {f"w{i}": i for i in range(vocab)}
 
 
-def imdb_train(word_dict=None, n_synth: int = 2000):
+def _imdb_reader(split: str, word_dict, n_synth: int, seed: int):
     vocab = len(word_dict) if word_dict else 5148
+    tar = _try_download(IMDB_URL, "imdb", IMDB_MD5)
 
     def reader():
-        yield from _synthetic_text(n_synth, vocab, 2, 10, 120, seed=11)
+        if tar and word_dict and "<unk>" in word_dict:
+            yield from parse_imdb(
+                tar, f"aclImdb/{split}/pos/.*\\.txt$",
+                f"aclImdb/{split}/neg/.*\\.txt$", word_dict)
+            return
+        yield from _synthetic_text(n_synth, vocab, 2, 10, 120, seed=seed)
 
     return reader
+
+
+def imdb_train(word_dict=None, n_synth: int = 2000):
+    return _imdb_reader("train", word_dict, n_synth, seed=11)
 
 
 def imdb_test(word_dict=None, n_synth: int = 400):
-    vocab = len(word_dict) if word_dict else 5148
-
-    def reader():
-        yield from _synthetic_text(n_synth, vocab, 2, 10, 120, seed=12)
-
-    return reader
+    return _imdb_reader("test", word_dict, n_synth, seed=12)
 
 
 # ------------------------------------------------------------------ imikolov
@@ -168,21 +366,17 @@ def imikolov_train(word_dict=None, n: int = 5, n_synth: int = 5000):
 
 # --------------------------------------------------------------- uci_housing
 
-def uci_housing_train(n_synth: int = 404):
+def _uci_housing_reader(test: bool, n_synth: int, seed: int):
+    path = _try_download(UCI_HOUSING_URL, "uci_housing", UCI_HOUSING_MD5)
+
     def reader():
-        rng = np.random.RandomState(14)
-        w = rng.randn(13).astype(np.float32)
-        for _ in range(n_synth):
-            x = rng.randn(13).astype(np.float32)
-            y = float(x @ w + 0.1 * rng.randn())
-            yield x, np.array([y], np.float32)
-
-    return reader
-
-
-def uci_housing_test(n_synth: int = 102):
-    def reader():
-        rng = np.random.RandomState(15)
+        if path:
+            train, tst = parse_uci_housing(path)
+            for row in (tst if test else train):
+                yield (row[:-1].astype(np.float32),
+                       row[-1:].astype(np.float32))
+            return
+        rng = np.random.RandomState(seed + 100)
         w = np.random.RandomState(14).randn(13).astype(np.float32)
         for _ in range(n_synth):
             x = rng.randn(13).astype(np.float32)
@@ -192,9 +386,20 @@ def uci_housing_test(n_synth: int = 102):
     return reader
 
 
+def uci_housing_train(n_synth: int = 404):
+    return _uci_housing_reader(False, n_synth, seed=14)
+
+
+def uci_housing_test(n_synth: int = 102):
+    return _uci_housing_reader(True, n_synth, seed=15)
+
+
 # --------------------------------------------------------------------- wmt14
 
 def wmt14_dicts(dict_size: int = 30000):
+    tar = _try_download(WMT14_TRAIN_URL, "wmt14", WMT14_TRAIN_MD5)
+    if tar:
+        return wmt14_read_dicts(tar, dict_size)
     src = {f"s{i}": i for i in range(dict_size)}
     trg = {f"t{i}": i for i in range(dict_size)}
     return src, trg
@@ -207,7 +412,12 @@ def wmt14_train(dict_size: int = 30000, n_synth: int = 2000):
     """Reader of (src_ids, trg_ids_with_<s>, trg_next_ids) triples
     (``v2/dataset/wmt14.py`` convention)."""
 
+    tar = _try_download(WMT14_TRAIN_URL, "wmt14", WMT14_TRAIN_MD5)
+
     def reader():
+        if tar:
+            yield from parse_wmt14(tar, "train/train", dict_size)
+            return
         rng = np.random.RandomState(16)
         for _ in range(n_synth):
             slen = int(rng.randint(5, 30))
@@ -222,7 +432,12 @@ def wmt14_train(dict_size: int = 30000, n_synth: int = 2000):
 
 
 def wmt14_test(dict_size: int = 30000, n_synth: int = 200):
+    tar = _try_download(WMT14_TRAIN_URL, "wmt14", WMT14_TRAIN_MD5)
+
     def reader():
+        if tar:
+            yield from parse_wmt14(tar, "test/test", dict_size)
+            return
         rng = np.random.RandomState(17)
         for _ in range(n_synth):
             slen = int(rng.randint(5, 30))

@@ -1,0 +1,76 @@
+"""Dataset download + md5 cache.
+
+Reference: ``python/paddle/v2/dataset/common.py:33-98`` — corpora are
+fetched once into ``~/.cache/paddle/dataset/<module>/`` and verified by
+md5; every loader goes through :func:`download` so a warm cache never
+touches the network.  This port keeps the exact cache layout (a cache
+populated by the reference is picked up as-is) and uses urllib (stdlib)
+instead of ``requests``.
+
+Sandboxed/zero-egress environments: set ``PADDLE_TPU_NO_DOWNLOAD=1`` to
+fail fast without a connection attempt; loaders in
+:mod:`paddle_tpu.data.datasets` catch :class:`DownloadError` and fall
+back to their synthetic surrogates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.request
+
+from ..utils import get_logger
+
+log = get_logger("dataset")
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATASET_CACHE", "~/.cache/paddle/dataset"))
+
+
+class DownloadError(RuntimeError):
+    pass
+
+
+def md5file(fname: str) -> str:
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def cached_path(url: str, module_name: str) -> str:
+    return os.path.join(DATA_HOME, module_name, url.split("/")[-1])
+
+
+def download(url: str, module_name: str, md5sum: str,
+             retry_limit: int = 3) -> str:
+    """Return the local path of ``url``, downloading + md5-verifying into
+    the cache if needed (``common.py:62`` semantics, including the retry
+    loop)."""
+    filename = cached_path(url, module_name)
+    os.makedirs(os.path.dirname(filename), exist_ok=True)
+    retry = 0
+    while not (os.path.exists(filename) and md5file(filename) == md5sum):
+        if os.environ.get("PADDLE_TPU_NO_DOWNLOAD"):
+            raise DownloadError(
+                f"{filename} not cached and downloads are disabled "
+                "(PADDLE_TPU_NO_DOWNLOAD)")
+        if retry >= retry_limit:
+            raise DownloadError(
+                f"cannot download {url} within {retry_limit} retries")
+        retry += 1
+        log.info("cache miss for %s, downloading %s (try %d)",
+                 filename, url, retry)
+        tmp = filename + ".part"
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            os.replace(tmp, filename)
+        except OSError as e:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise DownloadError(f"download of {url} failed: {e}") from e
+    return filename

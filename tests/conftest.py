@@ -15,6 +15,8 @@ os.environ["XLA_FLAGS"] = (
 os.environ["JAX_PLATFORMS"] = "cpu"
 # fp32 on CPU — bf16 matmuls are TPU-only territory; tests check numerics.
 os.environ.setdefault("PADDLE_TPU_USE_BF16", "0")
+# hermetic CI: dataset loaders must not attempt network downloads
+os.environ.setdefault("PADDLE_TPU_NO_DOWNLOAD", "1")
 
 import jax
 
