@@ -18,11 +18,11 @@ Three workloads (the first printed line is the driver-parsed metric):
    4-GPU LSTM row scaled to tokens (documented below).
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
-Adam).  Timing uses run-length differencing (time 1 step vs 1+N
-pipelined steps) because a single D2H sync over the axon tunnel costs
-~130 ms; a two-length consistency check (N and N/2 must agree) guards
-the method.  MFU is estimated from an analytic FLOP count over an
-assumed 197 TFLOP/s bf16 peak (v5e).
+Adam).  Timing chains K steps inside one ``lax.scan`` program (see
+:func:`_scan_time_ms`) because the axon tunnel's per-dispatch latency is
+the same order as a small step; ``timing_self_check`` is the relative
+spread of the warm K-step samples.  MFU is an exact-MAC FLOP count over
+an assumed 197 TFLOP/s bf16 peak (v5e).
 """
 
 import argparse
@@ -39,46 +39,13 @@ PEAK_FLOPS_BF16 = 197e12      # v5e chip peak, bf16
 TRAIN_FLOP_FACTOR = 3.0       # fwd + bwd ≈ 3× fwd matmul FLOPs
 
 
-def _diff_time_ms(step_fn, warmup=3, iters=20, max_tries=3, tol=0.15):
-    """Marginal device ms/step via run-length differencing.
-
-    The N vs N/2 consistency check is ENFORCED: if the two run lengths
-    disagree by more than ``tol`` (tunnel hiccup, host contention), the
-    measurement retries with doubled iters; after ``max_tries`` the
-    best-agreeing attempt is reported, with its (failing) agreement
-    score so readers can see the number is soft."""
-    for _ in range(warmup):
-        step_fn(sync=True)
-
-    def run(n):
-        t0 = time.perf_counter()
-        for i in range(n):
-            step_fn(sync=(i == n - 1))
-        return (time.perf_counter() - t0) * 1000.0
-
-    best = None
-    for _ in range(max_tries):
-        base = min(run(1) for _ in range(3))
-        full = min(run(1 + iters) for _ in range(2))
-        half = min(run(1 + iters // 2) for _ in range(2))
-        ms = max((full - base) / iters, 1e-3)
-        ms_half = max((half - base) / (iters // 2), 1e-3)
-        agree = abs(ms - ms_half) / max(ms, ms_half)
-        if best is None or agree < best[1]:
-            best = (ms, agree)
-        if agree <= tol:
-            return ms, agree
-        iters *= 2
-    return best
-
-
-def _scan_time_ms(trainer, feed, iters=20, max_tries=3, tol=0.2):
+def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
     """Device ms/step via K steps CHAINED INSIDE one jitted lax.scan.
 
-    The marginal-dispatch method (:func:`_diff_time_ms`) is at the mercy
-    of the axon tunnel's per-dispatch latency, which for small steps
-    (LSTM ~5 ms) is the same order as the step itself and varies run to
-    run.  Scanning K train steps inside one XLA program leaves exactly
+    Marginal-dispatch timing (time 1 vs 1+N pipelined dispatches) is at
+    the mercy of the axon tunnel's per-dispatch latency, which for small
+    steps (LSTM ~5 ms) is the same order as the step itself and varies
+    run to run.  Scanning K train steps inside one XLA program leaves exactly
     one dispatch + one D2H sync per measurement; ms/step is the K-step
     vs 1-step program difference divided by K-1.  ``timing_self_check``
     is the relative spread of the warm K-step samples — tunnel/host
@@ -108,27 +75,39 @@ def _scan_time_ms(trainer, feed, iters=20, max_tries=3, tol=0.2):
             return p, o, b, losses[-1]
         return run
 
-    def samples(run, n=3):
-        def copy(t):
-            return jax.tree_util.tree_map(lambda x: x.copy(), t)
+    def snapshot():
+        return jax.tree_util.tree_map(
+            lambda x: x.copy(),
+            (trainer.params, trainer.opt_state, trainer.buffers))
+
+    def samples(run, n=3, drop_first=True):
         times = []
         for _ in range(n):   # first sample pays the compile
-            p, o, b = (copy(trainer.params), copy(trainer.opt_state),
-                       copy(trainer.buffers))
+            p, o, b = snapshot()
             t0 = time.perf_counter()
             p, o, b, loss = run(p, o, b)
             float(loss)
             times.append((time.perf_counter() - t0) * 1000.0)
-        return times[1:]     # warm samples only
+        return times[1:] if drop_first else times
 
-    one = min(samples(k_steps(1)))
+    def one_step_time():
+        # the already-compiled single-step program shares the dispatch +
+        # sync fixed costs with the scan programs; using it as the
+        # baseline saves one scan(1) compile per workload
+        return min(samples(
+            lambda p, o, b: trainer._train_step(p, o, b, sfeed, rng,
+                                                progress),
+            drop_first=False))
+
+    one = one_step_time()
+    run = k_steps(1 + iters)     # compiled once, reused across retries
     for _ in range(max_tries):
-        warm = samples(k_steps(1 + iters))
+        warm = samples(run)
         ms = (min(warm) - one) / iters
         spread = (max(warm) - min(warm)) / max(min(warm), 1e-3)
         if ms > 0 and spread <= tol:
             return ms, spread
-        one = min(one, min(samples(k_steps(1))))   # re-baseline
+        one = min(one, one_step_time())   # re-baseline
     return max(ms, 1e-3), spread
 
 
@@ -212,7 +191,7 @@ def bench_resnet():
             "label": jax.numpy.asarray(
                 rng.randint(0, NCLASS, (B,)).astype(np.int32))}
 
-    ms, agree = _scan_time_ms(trainer, feed, iters=8)
+    ms, agree = _scan_time_ms(trainer, feed, iters=40)
     n = _n_chips(trainer)
     sps_chip = B / (ms / 1e3) / n
     # 3.858 GMACs fwd @224²: exact conv+fc MAC count of THIS config
@@ -298,7 +277,7 @@ def bench_seq2seq():
     B, S_LEN, T_LEN, V, E, H = 128, 30, 30, 30000, 512, 512
     trainer, feed = seq2seq_setup(B, S_LEN, T_LEN, V, E, H)
 
-    ms, agree = _scan_time_ms(trainer, feed, iters=16)
+    ms, agree = _scan_time_ms(trainer, feed, iters=128)
     n = _n_chips(trainer)
     tokens_per_sec = B * T_LEN / (ms / 1e3)
     # dominant matmuls fwd: encoder 2×GRU (3H gates from E and H) over
