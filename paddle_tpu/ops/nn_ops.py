@@ -33,6 +33,29 @@ def _pair(v: IntOr2) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _stem_space_to_depth(x, w, dn_format="NHWC"):
+    """Exact reformulation of the 7×7/stride-2/pad-3 stem conv as a
+    4×4/stride-1 conv over 2×2 space-to-depth blocks (the MLPerf conv0
+    optimization): with C=3 the MXU's 128-deep contraction is ~2% busy;
+    at 4C=12 the filter-gradient conv in particular stops being the
+    slowest kernel of the step.  Derivation: output row i reads input
+    rows 2i−3…2i+3 = block-rows i−2…i+1 → kernel 4, pad (2,1); kernel
+    entry (pu,a) holds W[2pu+a−1] (u=−1,7 fall off → zero-pad W to 8².
+    Same weights/checkpoint layout — the transform is per-step and XLA
+    constant-folds it outside the loop."""
+    n, h, w_, c = x.shape
+    x2 = x.reshape(n, h // 2, 2, w_ // 2, 2, c) \
+        .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w_ // 2, 4 * c)
+    kh, kw, ci, co = w.shape
+    w8 = jnp.zeros((8, 8, ci, co), w.dtype).at[1:8, 1:8].set(w)
+    w2 = w8.reshape(4, 2, 4, 2, ci, co).transpose(0, 2, 1, 3, 4, 5) \
+        .reshape(4, 4, 4 * ci, co)
+    dn = lax.conv_dimension_numbers(x2.shape, w2.shape,
+                                    (dn_format, "HWIO", dn_format))
+    return lax.conv_general_dilated(
+        x2, w2, (1, 1), [(2, 1), (2, 1)], dimension_numbers=dn)
+
+
 @register_op("conv2d")
 def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
            groups: int = 1, data_format: str = "NHWC"):
@@ -49,6 +72,12 @@ def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
         padding = [(padding, padding)] * 2
     elif isinstance(padding, (tuple, list)) and isinstance(padding[0], int):
         padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    if (data_format == "NHWC" and groups == 1 and x.ndim == 4
+            and w.shape[:2] == (7, 7) and w.shape[2] <= 4
+            and _pair(stride) == (2, 2) and _pair(dilation) == (1, 1)
+            and padding == [(3, 3), (3, 3)]
+            and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
+        return _stem_space_to_depth(x, w).astype(pol.output_dtype)
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         (data_format, "HWIO", data_format))

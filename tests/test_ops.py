@@ -314,3 +314,30 @@ def test_gradients_flow_through_seq_ops(rng):
     # gradient only on valid positions
     assert np.asarray(g)[1, 2:].sum() == 0
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_stem_space_to_depth_exact():
+    """The 7x7/s2/p3 stem conv rewrite (MLPerf conv0 space-to-depth)
+    must be numerically equivalent to the direct convolution."""
+    import jax
+    from jax import lax
+    from paddle_tpu.ops import nn_ops
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(7, 7, 3, 8).astype(np.float32))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    ref = lax.conv_general_dilated(x, w, (2, 2), [(3, 3), (3, 3)],
+                                   dimension_numbers=dn)
+    out = nn_ops._stem_space_to_depth(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-5)
+    # and the public conv2d path routes through it with matching grads
+    gref = jax.grad(lambda w: jnp.sum(jnp.sin(
+        lax.conv_general_dilated(x, w, (2, 2), [(3, 3), (3, 3)],
+                                 dimension_numbers=dn))))(w)
+    gout = jax.grad(lambda w: jnp.sum(jnp.sin(
+        nn_ops.conv2d(x, w, stride=2, padding=[(3, 3), (3, 3)]))))(w)
+    np.testing.assert_allclose(np.asarray(gout), np.asarray(gref),
+                               atol=2e-3, rtol=1e-4)
