@@ -191,6 +191,8 @@ def config_namespace() -> Dict[str, Any]:
         if not k.startswith("_") and callable(getattr(_networks, k)) \
                 and k not in ns:
             ns[k] = getattr(_networks, k)
+    from . import layer_math
+    ns["layer_math"] = layer_math
     from ..data import feeder
     for k in ("dense_vector", "integer_value", "integer_value_sequence",
               "sparse_binary_vector", "sparse_float_vector",

@@ -160,6 +160,7 @@ class ConfigCollector(threading.local):
         self.by_name: Dict[str, LayerConfig] = {}
         self.parameters: List[ParameterConfig] = []
         self.sub_models: List[SubModelConfig] = []
+        self.evaluators: List[Dict[str, Any]] = []
         self.counter = 0
         self.group_stack: List[SubModelConfig] = []
 
@@ -270,6 +271,8 @@ def _add_layer(name: Optional[str], ltype: str, size: int,
     conf = LayerConfig(
         name=name, type=ltype, size=size, active_type=_act_name(act),
         inputs=inputs, with_bias=with_bias,
+        bias_parameter_name=(bias_pa.name if bias_pa and bias_pa.name
+                             else ""),
         drop_rate=layer_attr.drop_rate if layer_attr else 0.0,
         device=layer_attr.device if layer_attr else -1,
         attrs=attrs or {})
@@ -433,11 +436,59 @@ class Operator:
     output_size: int = 0
 
 
+class _MixedLayerBuilder(LayerOutput):
+    """Context-manager form of ``mixed_layer`` (reference
+    ``MixedLayerType``):
+
+        with mixed_layer(size=n) as m:
+            m += full_matrix_projection(input=x)
+            m += dotmul_operator(a, b)
+
+    Items collect via ``+=``; the real layer is built at ``__exit__``
+    and this handle's LayerOutput fields are filled in place, so the
+    ``as`` variable is usable downstream like any other output."""
+
+    def __init__(self, **kw):
+        super().__init__(name="<unfinished-mixed>", layer_type="mixed")
+        self._kw = kw
+        self._items: list = []
+        self._finalized = False
+
+    def __iadd__(self, other):
+        if self._finalized:
+            # the handle is an ordinary LayerOutput now; += means
+            # layer_math addition like on any other output
+            from .layer_math import add
+            return add(self, other)
+        self._items.append(other)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        enforce(self._items, "mixed_layer context added no projections")
+        built = mixed(input=self._items, **self._kw)
+        self._finalized = True
+        self.name = built.name
+        self.layer_type = built.layer_type
+        self.size = built.size
+        self.parents = built.parents
+        return False
+
+
 def mixed(input=None, size: int = 0, name: Optional[str] = None, act=None,
           bias_attr=False, layer_attr=None, operators=None) -> LayerOutput:
     """``mixed_layer``: input is a list of projection tuples; operators
     are :class:`Operator` objects appended as extra (projection-less)
-    inputs."""
+    inputs.  Called with ``input=None`` it returns the context-manager
+    builder (the reference's ``with mixed_layer(...) as m`` protocol)."""
+    if input is None and operators is None:
+        return _MixedLayerBuilder(size=size, name=name, act=act,
+                                  bias_attr=bias_attr,
+                                  layer_attr=layer_attr)
     items = _as_list(input)
     ins, pcs, pas = [], [], []
     op_list = []
@@ -1314,11 +1365,19 @@ def topology(outputs: Input,
 
     for o in outs:
         visit(o.name)
+    # declared evaluators keep their input layers alive as extra graph
+    # roots (reference: evaluator inputs are part of the model)
+    for e in _collector.evaluators:
+        for key in ("input_layer_name", "label_layer_name",
+                    "weight_layer_name"):
+            if e.get(key) in by_name:
+                visit(e[key])
 
     # needed is already topologically ordered by the DFS append order
     layers = [by_name[n] for n in needed if n in by_name]
     used_groups = [sm for sm in _collector.sub_models
                    if any(ln in seen for ln in sm.layer_names)]
+    layer_names = {l.name for l in layers}
     return ModelConfig(
         layers=layers,
         parameters=list(_collector.parameters),
@@ -1326,6 +1385,8 @@ def topology(outputs: Input,
         output_layer_names=[o.name for o in _as_list(outputs)],
         sub_models=([SubModelConfig(name="root")] + used_groups)
         if used_groups else [],
+        evaluators=[e for e in _collector.evaluators
+                    if e.get("input_layer_name") in layer_names],
     )
 
 
@@ -1669,6 +1730,61 @@ def print_layer(input, format: Optional[str] = None,
 
 
 printer_layer = print_layer
+
+
+# ------------------------------------------------- config-time evaluators
+# trainer_config_helpers/evaluators.py __all__: each call registers an
+# EvaluatorConfig on the model; the Trainer instantiates and streams them
+# during --job=test (reference: Evaluator::create from ModelConfig,
+# paddle/gserver/evaluators/Evaluator.h:42).
+
+def evaluator_base(input, type: str, label=None, name: Optional[str] = None,
+                   weight=None, **attrs) -> None:
+    inp = _as_list(input)[0]
+    entry: Dict[str, Any] = {
+        "type": type,
+        "name": name or f"__{type}_evaluator_{len(_collector.evaluators)}__",
+        "input_layer_name": inp.name if isinstance(inp, LayerOutput) else inp,
+    }
+    if label is not None:
+        entry["label_layer_name"] = label.name \
+            if isinstance(label, LayerOutput) else label
+    if weight is not None:
+        entry["weight_layer_name"] = weight.name \
+            if isinstance(weight, LayerOutput) else weight
+    entry.update({k: v for k, v in attrs.items() if v is not None})
+    _collector.evaluators.append(entry)
+
+
+def _mk_evaluator_fn(public: str, registry: str):
+    def fn(input, label=None, name: Optional[str] = None, **kw) -> None:
+        evaluator_base(input, registry, label=label, name=name, **kw)
+
+    fn.__name__ = public
+    fn.__doc__ = f"``{public}``: registers a ``{registry}`` evaluator " \
+                 "on the model config."
+    return fn
+
+
+_EVALUATOR_NAME_MAP = {
+    "classification_error_evaluator": "classification_error",
+    "auc_evaluator": "auc",
+    "pnpair_evaluator": "pnpair",
+    "precision_recall_evaluator": "precision_recall",
+    "ctc_error_evaluator": "ctc_edit_distance",
+    "chunk_evaluator": "chunk",
+    "sum_evaluator": "sum",
+    "column_sum_evaluator": "column_sum",
+    "value_printer_evaluator": "value_printer",
+    "gradient_printer_evaluator": "gradient_printer",
+    "maxid_printer_evaluator": "maxid_printer",
+    "maxframe_printer_evaluator": "maxframe_printer",
+    "seqtext_printer_evaluator": "seq_text_printer",
+    "classification_error_printer_evaluator": "classification_error_printer",
+    "detection_map_evaluator": "detection_map",
+}
+for _pub, _reg in _EVALUATOR_NAME_MAP.items():
+    globals()[_pub] = _mk_evaluator_fn(_pub, _reg)
 
 
 def get_output_layer(input: LayerOutput, arg_name: str,

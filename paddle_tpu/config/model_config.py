@@ -115,6 +115,9 @@ class ModelConfig:
     input_layer_names: List[str] = field(default_factory=list)
     output_layer_names: List[str] = field(default_factory=list)
     sub_models: List[SubModelConfig] = field(default_factory=list)
+    # EvaluatorConfig entries: {"type", "name", "input_layer_name",
+    # "label_layer_name", **extra attrs} (``ModelConfig.proto`` evaluators)
+    evaluators: List[Dict[str, Any]] = field(default_factory=list)
 
     def layer_map(self) -> Dict[str, LayerConfig]:
         return {l.name: l for l in self.layers}
@@ -165,6 +168,7 @@ class ModelConfig:
             input_layer_names=raw.get("input_layer_names", []),
             output_layer_names=raw.get("output_layer_names", []),
             sub_models=[SubModelConfig(**s) for s in raw.get("sub_models", [])],
+            evaluators=raw.get("evaluators", []),
         )
 
 
