@@ -90,6 +90,8 @@ if is_generator_training or is_discriminator_training:
     label = data_layer(name="label", type=integer_value(2))
     prob = discriminator(sample)
     cost = cross_entropy(input=prob, label=label)
+    classification_error_evaluator(
+        input=prob, label=label, name=mode + "_error")
     outputs(cost)
 
 if is_generator:

@@ -214,7 +214,11 @@ class Trainer:
 
     def _build_eval_step(self):
         net = self.network
-        eval_names = self._eval_output_names()
+        eval_names = list(self._eval_output_names())
+        # config-declared evaluators read their own input layers
+        eval_names += [e["input_layer_name"]
+                       for e in net.config.evaluators
+                       if e.get("input_layer_name")]
 
         def step(params, buffers, feed):
             loss, (values, _) = net.loss(params, feed, buffers,
@@ -226,6 +230,25 @@ class Trainer:
             return loss, outs
 
         return jax.jit(step)
+
+    def _config_evaluators(self):
+        """Instantiate the model config's EvaluatorConfig entries
+        (reference: ``Evaluator::create`` from ``ModelConfig``)."""
+        from ..evaluators import create_evaluator
+
+        out = []
+        for e in self.network.config.evaluators:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("type", "name", "input_layer_name",
+                                  "label_layer_name",
+                                  "weight_layer_name")}
+            try:
+                ev = create_evaluator(e["type"], **extra)
+            except TypeError:
+                ev = create_evaluator(e["type"])
+            ev._config_entry = e
+            out.append(ev)
+        return out
 
     def train_one_batch(self, feed: Dict[str, Any]) -> float:
         """``TrainerInternal::trainOneBatch`` equivalent (one jit call)."""
@@ -287,9 +310,13 @@ class Trainer:
 
     def test(self, reader, feeder=None, evaluators: Sequence = (),
              label_name: str = "label") -> Dict[str, float]:
-        """``Tester::test`` equivalent."""
+        """``Tester::test`` equivalent.  With no explicit ``evaluators``,
+        the model config's declared evaluators run (the v1
+        ``*_evaluator(...)`` config calls)."""
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
+        if not evaluators:
+            evaluators = self._config_evaluators()
         total, n = 0.0, 0
         eval_names = self._eval_output_names() if evaluators else []
         for e in evaluators:
@@ -306,12 +333,38 @@ class Trainer:
                 out0 = outputs.get(eval_names[0]) if eval_names else None
                 if out0 is None:
                     out0 = next(iter(outputs.values()))
-                label = feed.get(label_name)
                 for e in evaluators:
-                    e.eval_batch(out0, label)
+                    entry = getattr(e, "_config_entry", None)
+                    if entry:
+                        ein = outputs.get(entry["input_layer_name"])
+                        if ein is None:
+                            log.warning(
+                                "evaluator %s: input layer %r not in "
+                                "eval outputs; skipping",
+                                entry.get("name"),
+                                entry["input_layer_name"])
+                            continue
+                        elab = feed.get(entry.get("label_layer_name",
+                                                  label_name))
+                        w = feed.get(entry["weight_layer_name"]) \
+                            if entry.get("weight_layer_name") else None
+                        if w is not None and "weight" in \
+                                e.eval_batch.__code__.co_varnames:
+                            e.eval_batch(ein, elab, weight=w)
+                        else:
+                            e.eval_batch(ein, elab)
+                    else:
+                        e.eval_batch(out0, feed.get(label_name))
         metrics = {"test_cost": total / max(n, 1)}
         for e in evaluators:
-            metrics.update(e.finish())
+            vals = e.finish()
+            entry = getattr(e, "_config_entry", None)
+            ename = (entry or {}).get("name", "")
+            if ename and not ename.startswith("__"):
+                # explicit evaluator names prefix their metrics, so two
+                # same-type evaluators don't overwrite each other
+                vals = {f"{ename}.{k}": v for k, v in vals.items()}
+            metrics.update(vals)
         return metrics
 
     def time_job(self, reader, feeder=None, warmup: int = 3,

@@ -152,3 +152,44 @@ def test_checkpoint_roundtrip(tmp_path):
                 np.asarray(trainer.core.params[k]),
                 np.asarray(trainer2.core.params[k]))
         assert trainer2.core.samples_seen == trainer.core.samples_seen
+
+
+def test_config_declared_evaluators_run_in_test_job(tmp_path):
+    """v1 configs call *_evaluator(...) at config time; --job=test must
+    instantiate and stream them (reference Evaluator::create from
+    ModelConfig)."""
+    import jax.numpy as jnp
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.layers import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+
+    with config_scope():
+        from paddle_tpu.data.feeder import dense_vector, integer_value
+        x = dsl.data_layer("x", dense_vector(8))
+        y = dsl.data_layer("y", integer_value(3))
+        pred = dsl.fc_layer(x, size=3, act=dsl.SoftmaxActivation(),
+                            name="pred")
+        dsl.classification_error_evaluator(pred, label=y)
+        dsl.sum_evaluator(pred)
+        cfg = dsl.topology(dsl.classification_cost(pred, y))
+    assert len(cfg.evaluators) == 2
+    assert cfg.evaluators[0]["type"] == "classification_error"
+    assert cfg.evaluators[0]["label_layer_name"] == "y"
+
+    net = NeuralNetwork(cfg)
+    tr = Trainer(net)
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(3):
+            yield [(rng.randn(8).astype(np.float32),
+                    int(rng.randint(3)))]
+
+    from paddle_tpu.data.feeder import DataFeeder, dense_vector, \
+        integer_value
+    feeder = DataFeeder([("x", dense_vector(8)), ("y", integer_value(3))])
+    metrics = tr.test(reader, feeder, label_name="y")
+    assert "classification_error" in metrics
+    assert 0.0 <= metrics["classification_error"] <= 1.0
+    assert "sum" in metrics or any("sum" in k for k in metrics)
