@@ -56,8 +56,20 @@ def _feeder_for(provider, model):
 
 def cmd_train(args) -> int:
     from .config.config_parser import parse_config
+    from .distributed.launch import cluster_env, initialize_cluster
     from .layers.network import NeuralNetwork
     from .parallel.local_sgd import make_trainer
+
+    if cluster_env() or args.distributed:
+        # PADDLE_COORDINATOR set, or --distributed for TPU-pod
+        # auto-detection
+        env = cluster_env()
+        if env and env["coordinator_address"] and not (
+                env["num_processes"] and env["process_id"] is not None):
+            log.error("PADDLE_COORDINATOR requires PADDLE_NUM_NODES "
+                      "and PADDLE_NODE_ID")
+            return 2
+        initialize_cluster()
 
     model, opt, ds = parse_config(args.config, args.config_args)
     log.info("config parsed: %d layers, batch_size=%d, method=%s",
@@ -142,6 +154,16 @@ def cmd_dump_config(args) -> int:
     return 0
 
 
+def cmd_diagram(args) -> int:
+    """``make_model_diagram.py`` equivalent: config → graphviz DOT."""
+    from .config.config_parser import parse_config
+    from .utils.model_diagram import model_to_dot
+
+    model, _, _ = parse_config(args.config, args.config_args)
+    print(model_to_dot(model))
+    return 0
+
+
 def cmd_version(_args) -> int:
     import jax
 
@@ -165,6 +187,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     tp.add_argument("--save_dir", default="")
     tp.add_argument("--init_model_path", default="")
     tp.add_argument("--test_period", type=int, default=0)
+    tp.add_argument("--distributed", action="store_true",
+                    help="join/auto-detect a multi-host cluster "
+                         "(jax.distributed)")
     tp.add_argument("--mesh_shape", default="",
                     help="e.g. data=4,model=2 (replaces --trainer_count)")
     tp.add_argument("--use_bf16", type=int, default=None)
@@ -190,6 +215,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     dp.add_argument("--whole", action="store_true",
                     help="include optimization + data config")
     dp.set_defaults(fn=cmd_dump_config)
+
+    gp = sub.add_parser("diagram",
+                        help="emit a graphviz DOT diagram of a config")
+    gp.add_argument("config")
+    gp.add_argument("config_args", nargs="?", default="")
+    gp.set_defaults(fn=cmd_diagram)
 
     vp = sub.add_parser("version", help="print build info")
     vp.set_defaults(fn=cmd_version)

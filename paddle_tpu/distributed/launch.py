@@ -1,0 +1,97 @@
+"""Multi-host cluster launch — the ``cluster_train`` equivalent.
+
+Reference: ``paddle/scripts/cluster_train/paddle.py`` (fabric/ssh
+process launcher + pserver endpoint lists) and
+``cluster_train_v2/openmpi`` — infrastructure whose only job is to start
+N trainer processes that find each other.  TPU-native replacement:
+``jax.distributed.initialize`` — every host runs the SAME program, the
+coordinator handles rendezvous, and the global device mesh spans all
+hosts; gradient exchange stays inside the jitted step (ICI within a
+slice, DCN across slices), no pserver endpoints to wire.
+
+Usage (same command on every host):
+
+    PADDLE_COORDINATOR=host0:1234 PADDLE_NUM_NODES=4 PADDLE_NODE_ID=$i \\
+        python -m paddle_tpu train --config ... --mesh_shape data=32
+
+or programmatically ``initialize_cluster(...)`` before any jax call.
+On Cloud TPU pods the three env vars are unnecessary —
+``jax.distributed.initialize()`` auto-detects the pod topology.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ..utils import get_logger
+
+log = get_logger("launch")
+
+ENV_COORDINATOR = "PADDLE_COORDINATOR"
+ENV_NUM_NODES = "PADDLE_NUM_NODES"
+ENV_NODE_ID = "PADDLE_NODE_ID"
+
+
+def cluster_env() -> Optional[Dict[str, str]]:
+    """The launch-relevant environment, or None when single-host."""
+    if ENV_COORDINATOR not in os.environ:
+        return None
+    return {
+        "coordinator_address": os.environ[ENV_COORDINATOR],
+        "num_processes": os.environ.get(ENV_NUM_NODES),
+        "process_id": os.environ.get(ENV_NODE_ID),
+    }
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> bool:
+    """Join (or auto-detect) the multi-host cluster.  Returns True when
+    a multi-process runtime was initialized.  Must run before the first
+    jax device use.  Arguments default to the ``PADDLE_*`` env vars; on
+    TPU pods everything can be auto-detected by jax."""
+    import jax
+
+    env = cluster_env() or {}
+    coordinator_address = coordinator_address or \
+        env.get("coordinator_address")
+    if num_processes is None and env.get("num_processes"):
+        num_processes = int(env["num_processes"])
+    if process_id is None and env.get("process_id"):
+        process_id = int(env["process_id"])
+    if coordinator_address is None and num_processes is None:
+        try:  # TPU pod auto-detection
+            jax.distributed.initialize()
+        except Exception:
+            return False
+        ok = jax.process_count() > 1
+        if ok:
+            log.info("cluster: auto-detected %d processes",
+                     jax.process_count())
+        return ok
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("cluster: process %d/%d via %s (%d global devices)",
+             jax.process_index(), jax.process_count(),
+             coordinator_address, jax.device_count())
+    return True
+
+
+def global_mesh(axes: Dict[str, int]):
+    """Build a mesh over ALL processes' devices (the multi-host
+    ``--mesh_shape``); axis sizes must multiply to the global device
+    count."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    names = tuple(axes)
+    sizes = tuple(axes.values())
+    if int(np.prod(sizes)) != devices.size:
+        raise ValueError(f"mesh {axes} needs {np.prod(sizes)} devices, "
+                         f"cluster has {devices.size}")
+    return Mesh(devices.reshape(sizes), names)

@@ -106,14 +106,27 @@ class Trainer:
         n = self.mesh.shape.get(DATA_AXIS, 1)
         if n <= 1:
             return feed
-        out = {}
-        for k, v in feed.items():
-            out[k] = jax.tree_util.tree_map(
-                lambda x: jax.device_put(
-                    x, data_sharding(self.mesh, np.ndim(x)))
-                if np.ndim(x) >= 1 and np.shape(x)[0] % n == 0
-                else jax.device_put(x, replicated(self.mesh)), v)
-        return out
+        multihost = jax.process_count() > 1
+
+        def place(x):
+            if np.ndim(x) >= 1 and np.shape(x)[0] % max(
+                    n // jax.process_count(), 1) == 0:
+                if multihost:
+                    # each process feeds its LOCAL rows; the global batch
+                    # is their concatenation over the data axis
+                    # (cluster_train: every trainer reads its own shard)
+                    gshape = ((np.shape(x)[0] * jax.process_count(),)
+                              + np.shape(x)[1:])
+                    return jax.make_array_from_process_local_data(
+                        data_sharding(self.mesh, np.ndim(x)),
+                        np.asarray(x), gshape)
+                if np.shape(x)[0] % n == 0:
+                    return jax.device_put(
+                        x, data_sharding(self.mesh, np.ndim(x)))
+            return jax.device_put(x, replicated(self.mesh))
+
+        return {k: jax.tree_util.tree_map(place, v)
+                for k, v in feed.items()}
 
     def _replicate(self, tree):
         if self.mesh.devices.size <= 1:
@@ -242,10 +255,7 @@ class Trainer:
                      if k not in ("type", "name", "input_layer_name",
                                   "label_layer_name",
                                   "weight_layer_name")}
-            try:
-                ev = create_evaluator(e["type"], **extra)
-            except TypeError:
-                ev = create_evaluator(e["type"])
+            ev = create_evaluator(e["type"], **extra)
             ev._config_entry = e
             out.append(ev)
         return out
@@ -361,9 +371,14 @@ class Trainer:
             entry = getattr(e, "_config_entry", None)
             ename = (entry or {}).get("name", "")
             if ename and not ename.startswith("__"):
-                # explicit evaluator names prefix their metrics, so two
-                # same-type evaluators don't overwrite each other
+                # explicit evaluator names always prefix their metrics
                 vals = {f"{ename}.{k}": v for k, v in vals.items()}
+            else:
+                # auto-named evaluators prefix only on collision, so two
+                # same-type evaluators don't overwrite each other
+                vals = {(k if k not in metrics
+                         else f"{ename.strip('_')}.{k}"): v
+                        for k, v in vals.items()}
             metrics.update(vals)
         return metrics
 
