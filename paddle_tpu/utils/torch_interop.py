@@ -1,0 +1,88 @@
+"""Import PyTorch-trained weights — the ``torch2paddle`` answer.
+
+Reference: ``python/paddle/utils/torch2paddle.py`` converted serialized
+Torch7 ``.t7`` models into reference ``Parameter`` files.  The modern
+equivalent: take a ``torch.nn`` state_dict (torch-cpu is available in
+this stack) and emit either our parameter dict or a reference-layout
+model dir (``trainer/interop.py`` raw buffers), with the layout
+conversions the two frameworks disagree on handled here:
+
+- ``nn.Linear.weight`` is ``[out, in]`` (y = x Wᵀ + b); our fc weights
+  are ``[in, out]`` → transposed.
+- ``nn.Conv2d.weight`` is ``[out, in, kh, kw]`` (NCHW/OIHW); our convs
+  are NHWC/HWIO → permuted to ``[kh, kw, in, out]``.
+- biases/norm scales carry over unchanged.  NOTE auto-detection treats
+  EVERY 2-D ``*.weight`` as a Linear weight — for ``nn.Embedding``
+  (also 2-D, but already ``[vocab, dim]``) pass
+  ``kinds={"emb.weight": "raw"}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+def convert_tensor(name: str, value, kind: Optional[str] = None
+                   ) -> np.ndarray:
+    """Convert one state_dict tensor to our layout.
+
+    ``kind`` overrides auto-detection: "linear_weight", "conv_weight",
+    or "raw".
+    """
+    arr = np.asarray(value.detach().cpu().numpy()
+                     if hasattr(value, "detach") else value)
+    if kind is None:
+        if name.endswith(".weight") and arr.ndim == 2:
+            kind = "linear_weight"
+        elif name.endswith(".weight") and arr.ndim == 4:
+            kind = "conv_weight"
+        else:
+            kind = "raw"
+    if kind == "linear_weight":
+        return np.ascontiguousarray(arr.T)          # [out,in] -> [in,out]
+    if kind == "conv_weight":
+        return np.ascontiguousarray(
+            arr.transpose(2, 3, 1, 0))              # OIHW -> HWIO
+    return arr
+
+
+def torch_state_dict_to_params(
+        state_dict: Mapping[str, Any],
+        name_map: Mapping[str, str],
+        kinds: Optional[Mapping[str, str]] = None
+        ) -> Dict[str, np.ndarray]:
+    """Map a torch state_dict into our parameter dict.
+
+    ``name_map``: {torch_name: our_param_name}; entries absent from the
+    state_dict raise.  ``kinds`` optionally overrides per-torch-name
+    layout conversion.
+    """
+    out: Dict[str, np.ndarray] = {}
+    for tname, pname in name_map.items():
+        if tname not in state_dict:
+            raise KeyError(f"torch state_dict lacks {tname!r} "
+                           f"(has {sorted(state_dict)[:8]}...)")
+        out[pname] = convert_tensor(
+            tname, state_dict[tname],
+            (kinds or {}).get(tname))
+    return out
+
+
+def import_torch_model(module_or_state_dict,
+                       name_map: Mapping[str, str],
+                       save_dir: Optional[str] = None,
+                       kinds: Optional[Mapping[str, str]] = None
+                       ) -> Dict[str, np.ndarray]:
+    """state_dict (or nn.Module) → our params; optionally also write a
+    reference-layout model dir (``Parameter::save`` raw buffers) so the
+    result feeds ``merge_model`` / ``--init_model_path`` directly."""
+    sd = module_or_state_dict
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    params = torch_state_dict_to_params(sd, name_map, kinds)
+    if save_dir:
+        from ..trainer.interop import save_reference_model_dir
+        save_reference_model_dir(save_dir, params)
+    return params

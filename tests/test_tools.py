@@ -1,0 +1,99 @@
+"""Misc tool parity: torch weight import, plot, model diagram.
+
+References: ``python/paddle/utils/torch2paddle.py``,
+``python/paddle/v2/plot/plot.py``,
+``python/paddle/utils/make_model_diagram.py``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.layers import NeuralNetwork
+
+
+def test_torch_linear_import_matches_forward():
+    """A torch MLP's weights imported through torch_interop must produce
+    (near-)identical logits in our fc layers."""
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import value_of
+    from paddle_tpu.utils.torch_interop import import_torch_model
+
+    torch.manual_seed(0)
+    tm = torch.nn.Sequential(
+        torch.nn.Linear(6, 5), torch.nn.ReLU(), torch.nn.Linear(5, 3))
+    with config_scope():
+        from paddle_tpu.data.feeder import dense_vector
+        x = dsl.data_layer("x", dense_vector(6))
+        h = dsl.fc_layer(x, size=5, act=dsl.ReluActivation(), name="h")
+        out = dsl.fc_layer(h, size=3, act=dsl.LinearActivation(),
+                           name="out")
+        cfg = dsl.topology(out)
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    imported = import_torch_model(tm, {
+        "0.weight": "_h.w0", "0.bias": "_h.wbias",
+        "2.weight": "_out.w0", "2.bias": "_out.wbias"})
+    for k, v in imported.items():
+        assert k in params, (k, sorted(params))
+        assert np.shape(v) == np.shape(params[k]), k
+        params[k] = jnp.asarray(v)
+
+    xb = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    want = tm(torch.from_numpy(xb)).detach().numpy()
+    got, _ = net.forward(params, {"x": jnp.asarray(xb)},
+                         net.init_buffers(), is_training=False)
+    np.testing.assert_allclose(np.asarray(value_of(got["out"])), want,
+                               atol=1e-5)
+
+
+def test_torch_conv_import_matches_forward():
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_ops import conv2d
+    from paddle_tpu.utils.torch_interop import convert_tensor
+
+    torch.manual_seed(1)
+    conv = torch.nn.Conv2d(3, 4, kernel_size=3, padding=1, bias=False)
+    xb = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    want = conv(torch.from_numpy(xb)).detach().numpy()  # NCHW
+    w = convert_tensor("conv.weight", conv.weight)       # -> HWIO
+    x_nhwc = jnp.asarray(xb.transpose(0, 2, 3, 1))
+    got = np.asarray(conv2d(x_nhwc, jnp.asarray(w), stride=1,
+                            padding=[(1, 1), (1, 1)]))
+    np.testing.assert_allclose(got.transpose(0, 3, 1, 2), want,
+                               atol=2e-5)
+
+
+def test_ploter_saves_png(tmp_path):
+    from paddle_tpu.v2.plot import Ploter
+
+    p = Ploter("train_cost", "test_cost")
+    for i in range(5):
+        p.append("train_cost", i, 1.0 / (i + 1))
+        p.append("test_cost", i, 1.2 / (i + 1))
+    out = str(tmp_path / "curve.png")
+    p.plot(path=out)
+    assert os.path.getsize(out) > 0
+    p.reset()
+    assert p.__plot_data__["train_cost"].step == []
+
+
+def test_model_diagram_dot():
+    from paddle_tpu.utils.model_diagram import model_to_dot
+
+    with config_scope():
+        from paddle_tpu.data.feeder import dense_vector, integer_value
+        x = dsl.data_layer("x", dense_vector(4))
+        y = dsl.data_layer("y", integer_value(2))
+        pred = dsl.fc_layer(x, size=2, act=dsl.SoftmaxActivation(),
+                            name="pred")
+        cfg = dsl.topology(dsl.classification_cost(pred, y))
+    dot = model_to_dot(cfg)
+    assert dot.startswith("digraph")
+    assert '"x" -> "pred"' in dot
+    assert "tomato" in dot  # cost layer highlighted
