@@ -33,6 +33,9 @@ class RecurrentGroup:
     # Epilogue hoisting (see :meth:`_split_scan_epilogue`); class attr so
     # tests can compare hoisted vs in-scan execution.
     HOIST = True
+    # scan unroll for the sequential phase (amortizes per-step loop
+    # overhead; same knob as ops/recurrent_ops._UNROLL)
+    UNROLL = 1
 
     def __init__(self, sub: SubModelConfig, model: ModelConfig):
         self.sub = sub
@@ -274,7 +277,8 @@ class RecurrentGroup:
 
         inp = dict(xs)
         inp["__mask__"] = m_t
-        _, stacked = jax.lax.scan(scan_fn, mems0, inp)
+        _, stacked = jax.lax.scan(scan_fn, mems0, inp,
+                                  unroll=self.UNROLL)
 
         for o in scan_outs:
             data = jnp.moveaxis(stacked[o], 0, 1)  # [B, T, ...]

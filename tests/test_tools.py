@@ -97,3 +97,38 @@ def test_model_diagram_dot():
     assert dot.startswith("digraph")
     assert '"x" -> "pred"' in dot
     assert "tomato" in dot  # cost layer highlighted
+
+
+def test_image_preprocessing_pipeline(tmp_path):
+    """v2.image surface (reference python/paddle/v2/image.py, PIL-based
+    here): resize-short preserves aspect, crops and CHW layout match."""
+    from PIL import Image
+    from paddle_tpu.v2 import image as im
+
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (48, 64, 3), dtype=np.uint8)
+    p = str(tmp_path / "t.png")
+    Image.fromarray(arr).save(p)
+
+    loaded = im.load_image(p)
+    assert loaded.shape == (48, 64, 3)
+    np.testing.assert_array_equal(loaded, arr)
+
+    r = im.resize_short(loaded, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[1] > r.shape[0]
+
+    c = im.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    rc = im.random_crop(r, 24, rng=np.random.RandomState(1))
+    assert rc.shape[:2] == (24, 24)
+
+    chw = im.to_chw(c)
+    assert chw.shape == (3, 32, 32)
+    np.testing.assert_array_equal(im.left_right_flip(c), c[:, ::-1])
+
+    out = im.simple_transform(loaded, 40, 32, is_train=False,
+                              mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+
+    raw = open(p, "rb").read()
+    np.testing.assert_array_equal(im.load_image_bytes(raw), arr)
