@@ -353,9 +353,27 @@ def addto(input: Input, act=None, name: Optional[str] = None,
 addto_layer = addto
 
 
+def _proj_out_size(pc: ProjConfig) -> int:
+    size = pc.resolved_output_size()
+    enforce(size > 0,
+            f"{pc.type} projection inside concat_layer needs an explicit "
+            "size (pass size=N to the projection)")
+    return size
+
+
 def concat(input: Input, act=None, name: Optional[str] = None,
            bias_attr=False, layer_attr=None) -> LayerOutput:
     ins = _as_list(input)
+    if ins and isinstance(ins[0], tuple):
+        # Projection inputs → 'concat2' (projection outputs concatenated;
+        # reference layers.py:3309 CONCAT_PROJ_LAYER dispatch)
+        lis = [t[0] for t in ins]
+        pcs = [t[1] for t in ins]
+        pas = [t[2] for t in ins]
+        size = sum(_proj_out_size(pc) for pc in pcs)
+        return _add_layer(name, "concat2", size, _mk_inputs(lis, pas, pcs),
+                          act, bias_attr, layer_attr=layer_attr,
+                          param_attrs=pas)
     return _add_layer(name, "concat", sum(i.size for i in ins),
                       _mk_inputs(ins), act, bias_attr,
                       layer_attr=layer_attr)

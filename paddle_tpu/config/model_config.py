@@ -58,6 +58,21 @@ class ProjConfig:
     # multi-slice form (SliceProjection concatenates selected ranges)
     slices: Optional[List[Tuple[int, int]]] = None
 
+    def resolved_output_size(self) -> int:
+        """Projection output width, derived from the type when
+        ``output_size`` is unset; 0 when underdetermined (an unsized
+        fc/trans_fc/table)."""
+        if self.output_size:
+            return self.output_size
+        if self.type == "context":
+            return self.context_length * self.input_size
+        if self.type == "slice":
+            slices = self.slices or [(self.slice_begin, self.slice_end)]
+            return sum(e - b for b, e in slices)
+        if self.type in ("identity", "dot_mul", "scaling"):
+            return self.input_size
+        return 0
+
 
 @dataclass
 class LayerInput:

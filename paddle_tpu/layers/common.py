@@ -177,23 +177,28 @@ class MixedLayer(Layer):
             p = inp.proj
             if p is None:
                 continue
+            # shared sizing rule (ProjConfig.resolved_output_size);
+            # the conf.size fallback is only sound for sum-of-projections
+            # (mixed) where every projection output IS the layer size —
+            # Concat2Layer.param_specs rejects unresolved sizes upfront
+            psize = p.resolved_output_size() or self.conf.size
             if p.type == "fc":
                 specs.append(self._weight_spec(
-                    i, (p.input_size, self.conf.size), initial_smart=True))
+                    i, (p.input_size, psize), initial_smart=True))
             elif p.type == "trans_fc":
                 # TransposedFullMatrixProjection: W is [out, in], applied
                 # transposed (trainer_config_helpers trans_full_matrix_projection)
                 specs.append(self._weight_spec(
-                    i, (self.conf.size, p.input_size), initial_smart=True))
+                    i, (psize, p.input_size), initial_smart=True))
             elif p.type == "dot_mul":
-                specs.append(self._weight_spec(i, (self.conf.size,),
+                specs.append(self._weight_spec(i, (psize,),
                                                initial_mean=1.0, initial_std=0.0))
             elif p.type == "scaling":
                 specs.append(self._weight_spec(i, (1,), initial_mean=1.0,
                                                initial_std=0.0))
             elif p.type == "table":
                 specs.append(self._weight_spec(
-                    i, (p.input_size, self.conf.size), initial_smart=True))
+                    i, (p.input_size, psize), initial_smart=True))
             elif p.type == "context" and p.trainable_padding:
                 begin = max(0, -p.context_start)
                 end = max(0, p.context_start + p.context_length - 1)
@@ -203,42 +208,52 @@ class MixedLayer(Layer):
             specs.append(self._bias_spec((self.conf.size,)))
         return specs
 
+    def _project(self, params, inputs, i):
+        """Apply input *i*'s projection; returns ``(y, template)`` where
+        template is non-None when the projection dictates sequence
+        structure (context projection)."""
+        x = inputs[i]
+        p = self.conf.inputs[i].proj
+        v = value_of(x)
+        template = None
+        if p.type == "fc":
+            y = value_of(_flat_apply(
+                lambda t: math_ops.matmul(t, params[self.weight_name(i)]), x))
+        elif p.type == "trans_fc":
+            y = value_of(_flat_apply(lambda t: math_ops.matmul(
+                t, params[self.weight_name(i)].T), x))
+        elif p.type == "identity":
+            y = v
+        elif p.type == "dot_mul":
+            y = v * params[self.weight_name(i)]
+        elif p.type == "scaling":
+            y = v * params[self.weight_name(i)][0]
+        elif p.type == "table":
+            y = embedding_ops.lookup_table(params[self.weight_name(i)], v)
+        elif p.type == "context":
+            enforce(isinstance(x, SequenceBatch),
+                    "context projection needs a sequence input")
+            pad_w = params.get(self.weight_name(i)) if p.trainable_padding else None
+            y = value_of(sequence_ops.context_projection(
+                x, p.context_start, p.context_length, pad_w))
+            template = x
+        elif p.type == "slice":
+            slices = getattr(p, "slices", None) or \
+                [(p.slice_begin, p.slice_end)]
+            y = jnp.concatenate([v[..., b:e] for b, e in slices], axis=-1)
+        else:
+            raise ConfigError(f"unknown projection type {p.type!r}")
+        return y, template
+
     def forward(self, params, inputs, ctx):
         out = None
         template = inputs[0]
         for i, x in enumerate(inputs):
-            p = self.conf.inputs[i].proj
-            if p is None:       # operator input — consumed by the
-                continue        # operators loop below
-            v = value_of(x)
-            if p.type == "fc":
-                y = _flat_apply(lambda t: math_ops.matmul(t, params[self.weight_name(i)]), x)
-                y = value_of(y)
-            elif p.type == "trans_fc":
-                y = _flat_apply(lambda t: math_ops.matmul(
-                    t, params[self.weight_name(i)].T), x)
-                y = value_of(y)
-            elif p.type == "identity":
-                y = v
-            elif p.type == "dot_mul":
-                y = v * params[self.weight_name(i)]
-            elif p.type == "scaling":
-                y = v * params[self.weight_name(i)][0]
-            elif p.type == "table":
-                y = embedding_ops.lookup_table(params[self.weight_name(i)], v)
-            elif p.type == "context":
-                enforce(isinstance(x, SequenceBatch),
-                        "context projection needs a sequence input")
-                pad_w = params.get(self.weight_name(i)) if p.trainable_padding else None
-                y = value_of(sequence_ops.context_projection(
-                    x, p.context_start, p.context_length, pad_w))
-                template = x
-            elif p.type == "slice":
-                slices = getattr(p, "slices", None) or \
-                    [(p.slice_begin, p.slice_end)]
-                y = jnp.concatenate([v[..., b:e] for b, e in slices], axis=-1)
-            else:
-                raise ConfigError(f"unknown projection type {p.type!r}")
+            if self.conf.inputs[i].proj is None:  # operator input — consumed
+                continue                          # by the operators loop below
+            y, tmpl = self._project(params, inputs, i)
+            if tmpl is not None:
+                template = tmpl
             out = y if out is None else out + y
         if self.conf.attrs.get("dot_mul_operator"):
             out = value_of(inputs[0]) * value_of(inputs[1]) * \
@@ -289,6 +304,45 @@ class MixedLayer(Layer):
         else:
             raise ConfigError(f"unknown mixed operator {kind!r}")
         return y if out is None else out + y
+
+
+@register_layer("concat2")
+class Concat2Layer(MixedLayer):
+    """``concat2``: like concat, but each input goes through its own
+    Projection and the projection *outputs* are concatenated instead of
+    summed (reference ``ConcatenateLayer2``,
+    ``paddle/gserver/layers/ConcatenateLayer.cpp:99``; emitted by
+    ``concat_layer`` when handed Projection inputs,
+    ``trainer_config_helpers/layers.py:3309``)."""
+
+    def param_specs(self):
+        total = 0
+        for i, inp in enumerate(self.conf.inputs):
+            enforce(inp.proj is not None,
+                    f"concat2 layer {self.conf.name!r} input {i} has no "
+                    "projection")
+            psize = inp.proj.resolved_output_size()
+            enforce(psize > 0,
+                    f"concat2 layer {self.conf.name!r} input {i}: "
+                    f"{inp.proj.type} projection needs an explicit size")
+            total += psize
+        enforce(total == self.conf.size,
+                f"concat2 layer {self.conf.name!r} size {self.conf.size} != "
+                f"sum of projection outputs {total}")
+        return super().param_specs()
+
+    def forward(self, params, inputs, ctx):
+        outs = []
+        template = inputs[0]
+        for i in range(len(inputs)):
+            y, tmpl = self._project(params, inputs, i)
+            if tmpl is not None:
+                template = tmpl
+            outs.append(y)
+        out = jnp.concatenate(outs, axis=-1)
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()]
+        return self.finalize(like(template, out), ctx)
 
 
 @register_layer("selective_fc")

@@ -41,3 +41,38 @@ class conll05:
 
 class criteo:
     train = staticmethod(_d.criteo_ctr_train)
+
+
+class movielens:
+    train = staticmethod(_d.movielens_train)
+    test = staticmethod(_d.movielens_test)
+    movie_categories = staticmethod(_d.movielens_movie_categories)
+    get_movie_title_dict = staticmethod(_d.movielens_get_movie_title_dict)
+    max_user_id = staticmethod(_d.movielens_max_user_id)
+    max_movie_id = staticmethod(_d.movielens_max_movie_id)
+    max_job_id = staticmethod(_d.movielens_max_job_id)
+    user_info = staticmethod(_d.movielens_user_info)
+    movie_info = staticmethod(_d.movielens_movie_info)
+
+
+class sentiment:
+    train = staticmethod(_d.sentiment_train)
+    test = staticmethod(_d.sentiment_test)
+    get_word_dict = staticmethod(_d.sentiment_word_dict)
+
+
+class voc2012:
+    train = staticmethod(_d.voc2012_train)
+    test = staticmethod(_d.voc2012_test)
+    val = staticmethod(_d.voc2012_val)
+
+
+class flowers:
+    train = staticmethod(_d.flowers_train)
+    test = staticmethod(_d.flowers_test)
+    valid = staticmethod(_d.flowers_valid)
+
+
+class mq2007:
+    train = staticmethod(_d.mq2007_train)
+    test = staticmethod(_d.mq2007_test)

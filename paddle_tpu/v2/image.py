@@ -74,7 +74,7 @@ def random_crop(im: np.ndarray, size: int, is_color: bool = True,
 
 
 def left_right_flip(im: np.ndarray) -> np.ndarray:
-    return im[:, ::-1] if len(im.shape) == 3 else im[:, ::-1]
+    return im[:, ::-1]
 
 
 def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,

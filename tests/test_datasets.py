@@ -102,3 +102,104 @@ def test_loaders_fall_back_synthetic(monkeypatch):
     assert x.shape == (13,) and y.shape == (1,)
     src, trg_in, trg_next = next(iter(datasets.wmt14_train()()))
     assert len(trg_in) == len(trg_next)
+
+
+def test_movielens_parser():
+    zp = os.path.join(FIX, "ml1m_tiny.zip")
+    movies, users, title_dict, cats_dict = \
+        datasets.parse_movielens_meta(zp)
+    assert set(movies) == {1, 2, 3}
+    assert users[1] == [1, 1, 0, 10]      # F → 1, age 1 → bucket 0
+    assert users[2][2] == len(datasets.AGE_TABLE) - 1  # age 56 → last
+    # "Toy Story (1995)" → year stripped, words dict-coded
+    toy_cats, toy_title = movies[1]
+    assert all(t in title_dict.values() for t in toy_title)
+    assert len(toy_cats) == 3 and len(toy_title) == 2
+    recs = list(datasets.parse_movielens_ratings(
+        zp, movies, users, is_test=False))
+    recs += list(datasets.parse_movielens_ratings(
+        zp, movies, users, is_test=True))
+    assert len(recs) == 6                 # split is a partition
+    r = recs[0]
+    # [uid, gender, age, job, mov_id, cats, title, [rating]]
+    assert len(r) == 8 and isinstance(r[5], list) and isinstance(r[7], list)
+    assert all(-5.0 <= rr[7][0] <= 5.0 for rr in recs)
+
+
+def test_sentiment_parser():
+    word_dict, data = datasets.parse_sentiment(
+        os.path.join(FIX, "movie_reviews_tiny.zip"))
+    # 'great' appears 3x, more than any other word → id 0
+    assert word_dict["great"] == 0
+    assert len(data) == 4
+    # neg/pos interleaved, labels 0/1
+    assert [lab for _, lab in data] == [0, 1, 0, 1]
+    ids, _ = data[0]
+    assert all(0 <= i < len(word_dict) for i in ids)
+
+
+def test_voc2012_parser():
+    tar = os.path.join(FIX, "voc2012_tiny.tar")
+    pairs = list(datasets.parse_voc2012(tar, "trainval"))
+    assert len(pairs) == 2
+    img, lab = pairs[0]
+    assert img.shape == (24, 32, 3) and img.dtype == np.uint8
+    assert lab.shape == (24, 32) and lab.max() < 21
+    assert len(list(datasets.parse_voc2012(tar, "val"))) == 1
+
+
+def test_flowers_parser_and_mapper():
+    samples = list(datasets.parse_flowers(
+        os.path.join(FIX, "102flowers_tiny.tgz"),
+        os.path.join(FIX, "imagelabels_tiny.mat"),
+        os.path.join(FIX, "setid_tiny.mat"),
+        datasets.FLOWERS_TRAIN_FLAG))
+    assert len(samples) == 4              # tstid = [1,2,3,4]
+    raw, label = samples[0]
+    assert isinstance(raw, bytes) and label == 0   # label 1 → 0-based
+    img, lab2 = datasets.flowers_default_mapper(False, samples[0])
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    assert lab2 == 0
+    val = list(datasets.parse_flowers(
+        os.path.join(FIX, "102flowers_tiny.tgz"),
+        os.path.join(FIX, "imagelabels_tiny.mat"),
+        os.path.join(FIX, "setid_tiny.mat"),
+        datasets.FLOWERS_VALID_FLAG))
+    assert [l for _, l in val] == [2]     # image 6 → label 3 → 2
+
+
+def test_mq2007_parser_and_formats():
+    path = os.path.join(FIX, "mq2007_tiny.txt")
+    qls = datasets.parse_mq2007(path)
+    assert [qid for qid, _ in qls] == [10, 11]
+    assert all(len(docs) == 4 for _, docs in qls)
+    _, docs = qls[0]
+    lab, feats = docs[0]
+    assert feats.shape == (46,) and feats.dtype == np.float32
+    # pairwise: every (better, worse) ordered pair; labels are 0,1,2,0
+    pairs = list(datasets._mq2007_pairwise(docs))
+    assert len(pairs) == 5
+    for one, hi, lo in pairs:
+        assert one == 1.0 and hi.shape == lo.shape == (46,)
+    # malformed lines are skipped
+    assert datasets.parse_mq2007_line("# comment only") is None
+
+
+def test_new_readers_synthetic_fallback(monkeypatch):
+    """Hermetic mode: every new loader must stream synthetic data."""
+    monkeypatch.setenv("PADDLE_TPU_NO_DOWNLOAD", "1")
+    monkeypatch.setattr(datasets, "_download_failed", set())
+    monkeypatch.setattr(datasets, "_MOVIELENS", datasets._MovielensMeta())
+    monkeypatch.setattr(datasets, "_SENTIMENT_CACHE", {})
+    r = datasets.movielens_train()()
+    rec = next(iter(r))
+    assert len(rec) == 8
+    wd = datasets.sentiment_word_dict()
+    ids, lab = next(iter(datasets.sentiment_train()()))
+    assert lab in (0, 1) and all(i < len(wd) for i in ids)
+    img, seg = next(iter(datasets.voc2012_train()()))
+    assert img.ndim == 3 and seg.ndim == 2
+    flat, flab = next(iter(datasets.flowers_train()()))
+    assert flat.shape == (3 * 224 * 224,) and 0 <= flab < 102
+    one, hi, lo = next(iter(datasets.mq2007_train()()))
+    assert one == 1.0 and hi.shape == (46,)

@@ -86,6 +86,41 @@ def test_mixed_projections_grad(rng):
     check_layer_grad(net, {"in0": _dense(rng, 3, 4), "in1": _dense(rng, 3, 6)})
 
 
+def test_concat2_projection_outputs(rng):
+    """concat2 concatenates per-input projection outputs
+    (ConcatenateLayer.cpp:99); fc output ‖ identity passthrough."""
+    net = build_single_layer_net(
+        "concat2", size=9, input_sizes=[4, 5],
+        projs=[ProjConfig(type="fc", input_size=4, output_size=4),
+               ProjConfig(type="identity", input_size=5, output_size=5)],
+        with_bias=True)
+    params = net.init_params()
+    x0, x1 = _dense(rng, 3, 4), _dense(rng, 3, 5)
+    values, _ = net.forward(params, {"in0": x0, "in1": x1})
+    out = np.asarray(values["test"])
+    assert out.shape == (3, 9)
+    w = params["_test.w0"]
+    b = params["_test.wbias"]
+    expect = np.concatenate([np.asarray(x0) @ np.asarray(w),
+                             np.asarray(x1)], axis=-1) + np.asarray(b)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    check_layer_grad(net, {"in0": x0, "in1": x1})
+
+
+def test_concat2_dsl_dispatch():
+    """concat_layer handed Projection tuples emits a concat2 layer
+    (reference layers.py:3309)."""
+    from paddle_tpu.config import dsl
+    dsl.reset_config()
+    a = dsl.data("a", size=4)
+    b = dsl.data("b", size=6)
+    out = dsl.concat([dsl.full_matrix_projection(a, size=3),
+                      dsl.identity_projection(b)])
+    assert out.layer_type == "concat2"
+    assert out.size == 9
+    dsl.reset_config()
+
+
 def test_mixed_context_projection(rng):
     net = build_single_layer_net(
         "mixed", size=12, input_sizes=[4],
