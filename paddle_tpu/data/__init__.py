@@ -1,4 +1,4 @@
-from . import datasets, reader
+from . import datasets, reader, recordio
 from .feeder import (
     DataFeeder,
     InputType,

@@ -557,7 +557,7 @@ def parse_movielens_ratings(zip_path: str, movies, users, is_test: bool,
 
 class _MovielensMeta:
     """Lazily-resolved corpus metadata with a synthetic surrogate
-    (4 users x 8 movies, 6 categories, latent-factor ratings)."""
+    (120 users x 80 movies, 6 categories, latent-factor ratings)."""
 
     N_USERS, N_MOVIES, N_CATS, N_JOBS, N_TITLE_WORDS = 120, 80, 6, 21, 40
 
@@ -824,8 +824,10 @@ def flowers_default_mapper(is_train: bool, sample):
 
     img_bytes, label = sample
     img = v2_image.load_image_bytes(img_bytes)
+    # the reference's mean is BGR-ordered (cv2 loader); our loader
+    # decodes RGB, so reverse it to hit the right channels
     img = v2_image.simple_transform(
-        img, 256, 224, is_train, mean=[103.94, 116.78, 123.68])
+        img, 256, 224, is_train, mean=[123.68, 116.78, 103.94])
     return img.flatten().astype(np.float32), label
 
 

@@ -74,3 +74,79 @@ def download(url: str, module_name: str, md5sum: str,
                 os.remove(tmp)
             raise DownloadError(f"download of {url} failed: {e}") from e
     return filename
+
+
+def split(reader, line_count: int, suffix: str = "%05d.pickle",
+          dumper=None) -> int:
+    """Split a reader's samples into fixed-size pickle shard files
+    (``v2/dataset/common.py:121``); returns the number of files
+    written."""
+    import pickle
+
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    lines, indx_f = [], 0
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines, indx_f = [], indx_f + 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+        indx_f += 1
+    return indx_f
+
+
+def cluster_files_reader(files_pattern: str, trainer_count: int,
+                         trainer_id: int, loader=None):
+    """Reader over the shard files produced by :func:`split`, taking
+    every ``trainer_count``-th file starting at ``trainer_id``
+    (``v2/dataset/common.py:158``)."""
+    import glob
+    import pickle
+
+    loader = loader or pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count != trainer_id:
+                continue
+            with open(fn, "rb") as f:
+                for sample in loader(f):
+                    yield sample
+
+    return reader
+
+
+def convert(output_path: str, reader, line_count: int,
+            name_prefix: str, shuffle_seed: int = 0) -> list:
+    """Convert a reader's samples to chunked recordio shard files
+    (``v2/dataset/common.py:194``); returns the shard paths.  Samples
+    are pickled per the reference convention; each shard shuffles its
+    buffer before writing."""
+    import pickle
+    import random
+
+    from . import recordio as rio
+
+    rand = random.Random(shuffle_seed)
+    paths, lines, indx_f = [], [], 0
+
+    def write_shard(idx, buf):
+        rand.shuffle(buf)
+        path = os.path.join(output_path, "%s-%05d" % (name_prefix, idx))
+        with rio.Writer(path) as w:
+            for sample in buf:
+                w.write(pickle.dumps(sample))
+        paths.append(path)
+
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            write_shard(indx_f, lines)
+            lines, indx_f = [], indx_f + 1
+    if lines:
+        write_shard(indx_f, lines)
+    return paths

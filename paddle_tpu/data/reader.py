@@ -224,3 +224,64 @@ def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
             yield b
 
     return batch_reader
+
+
+# ------------------------------------------------- recordio-backed creators
+
+def recordio(paths, buf_size: int = 100) -> Reader:
+    """Reader over chunked record files written by :func:`convert` /
+    :class:`paddle_tpu.data.recordio.Writer` (``creator.py:60``).
+    Records are pickled samples — these files are framework-produced
+    dataset caches, the reference's own convention."""
+    import pickle
+
+    from . import recordio as rio
+
+    def reader():
+        for rec in rio.reader(paths):
+            yield pickle.loads(rec)
+
+    return buffered(reader, buf_size)
+
+
+def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
+    """Master-coordinated distributed reader (``creator.py:91``): the
+    master leases recordio *chunks* to trainers so each record is
+    consumed once per pass cluster-wide, with failed leases re-queued.
+
+    :param master: a :class:`paddle_tpu.distributed.Master` /
+        ``MasterClient`` (replaces the reference's etcd endpoint — no
+        external coordinator in the TPU build).
+    """
+    import pickle
+
+    from . import recordio as rio
+
+    from ..distributed.master import master_reader
+
+    file_list = rio.expand_paths(paths)
+    payloads = []
+    for path in file_list:
+        for off, _n in rio.load_index(path):
+            payloads.append(f"{path}\t{off}")
+    master.set_dataset(payloads)
+
+    def load_chunk(payload):
+        path, off = payload.rsplit("\t", 1)
+        for rec in rio.read_chunk(path, int(off)):
+            yield pickle.loads(rec)
+
+    inner = master_reader(master, load_chunk)
+    first_pass = [True]
+
+    def reader():
+        # the trainer re-invokes reader() once per pass; re-arm the task
+        # queue for passes 2..N (reset_epoch is a no-op while work is
+        # still queued, so N trainers re-arm exactly once — the
+        # reference's start_get_records(pass_num) handshake)
+        if not first_pass[0]:
+            master.reset_epoch()
+        first_pass[0] = False
+        yield from inner()
+
+    return buffered(reader, buf_size)

@@ -76,3 +76,16 @@ class flowers:
 class mq2007:
     train = staticmethod(_d.mq2007_train)
     test = staticmethod(_d.mq2007_test)
+
+
+class common:
+    """``paddle.v2.dataset.common`` — download cache + shard tools."""
+
+    from ..data.download import (  # noqa: F401
+        DATA_HOME,
+        cluster_files_reader,
+        convert,
+        download,
+        md5file,
+        split,
+    )

@@ -3,14 +3,18 @@ from ..data.reader import (  # noqa: F401
     buffered,
     cache,
     chain,
+    cloud_reader,
     compose,
     firstn,
     map_readers,
     np_array,
+    recordio,
     shuffle,
     text_file,
     xmap_readers,
 )
 
 creator = type("creator", (), {"np_array": staticmethod(np_array),
-                               "text_file": staticmethod(text_file)})
+                               "text_file": staticmethod(text_file),
+                               "recordio": staticmethod(recordio),
+                               "cloud_reader": staticmethod(cloud_reader)})
