@@ -163,6 +163,9 @@ class ConfigCollector(threading.local):
         self.evaluators: List[Dict[str, Any]] = []
         self.counter = 0
         self.group_stack: List[SubModelConfig] = []
+        # explicit input order from inputs() — empty means derive from
+        # data layers in topological order
+        self.declared_inputs: List[str] = []
 
     def unique_name(self, prefix: str) -> str:
         self.counter += 1
@@ -1399,13 +1402,35 @@ def topology(outputs: Input,
     return ModelConfig(
         layers=layers,
         parameters=list(_collector.parameters),
-        input_layer_names=[l.name for l in layers if l.type == "data"],
+        input_layer_names=(_validated_inputs(layers)
+                           or [l.name for l in layers if l.type == "data"]),
         output_layer_names=[o.name for o in _as_list(outputs)],
         sub_models=([SubModelConfig(name="root")] + used_groups)
         if used_groups else [],
         evaluators=[e for e in _collector.evaluators
                     if e.get("input_layer_name") in layer_names],
     )
+
+
+def inputs(layers, *args) -> None:
+    """Declare the network input order explicitly
+    (``networks.py:1485``) — the data provider must feed in this order."""
+    ins = _as_list(layers) + list(args)
+    _collector.declared_inputs = [
+        l if isinstance(l, str) else l.name for l in ins]
+
+
+def _validated_inputs(kept_layers) -> List[str]:
+    """inputs() names checked against the final topology — a typo'd or
+    pruned layer fails at config time, as the reference Inputs() does."""
+    declared = _collector.declared_inputs
+    if declared:
+        kept = {l.name for l in kept_layers}
+        unknown = [n for n in declared if n not in kept]
+        if unknown:
+            raise ConfigError(
+                f"inputs() declares layers not in the topology: {unknown}")
+    return list(declared)
 
 
 @contextlib.contextmanager

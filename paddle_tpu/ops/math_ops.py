@@ -287,8 +287,10 @@ def row_l2_norm(x, eps: float = 1e-12):
 
 @register_op("convex_combination")
 def convex_combination(weights, x):
-    """Per-row convex combination: weights [B, K], x [B, K*D] → [B, D]
-    (``ConvexCombinationLayer``)."""
-    b, k = weights.shape
-    d = x.shape[1] // k
-    return jnp.einsum("bk,bkd->bd", weights, x.reshape(b, k, d))
+    """Per-row convex combination: weights [..., K], x [..., K*D] →
+    [..., D] (``ConvexCombinationLayer``); leading dims (batch, or
+    batch×time for sequence inputs) broadcast."""
+    k = weights.shape[-1]
+    d = x.shape[-1] // k
+    return jnp.einsum("...k,...kd->...d", weights,
+                      x.reshape(*x.shape[:-1], k, d))

@@ -350,3 +350,193 @@ def test_lstm_step_group_hoisting_equivalence():
                                   np.asarray(v_n["cell_seq"].data))
     np.testing.assert_array_equal(np.asarray(v_h["lstm_out"].data),
                                   np.asarray(v_n["lstm_out"].data))
+
+
+# ---------------------------------------------------------- networks.py
+# composite helpers (trainer_config_helpers/networks.py parity)
+
+
+def test_lstmemory_group_matches_manual_loop():
+    """lstmemory_group (networks.py:749): h memory + .state cell memory
+    + identity⊕W·h_prev mixed gates, verified against a numpy loop."""
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.data.feeder import dense_vector_sequence
+    from paddle_tpu.v2 import networks
+
+    with config_scope():
+        s = dsl.data_layer("s", dense_vector_sequence(8))
+        out = networks.lstmemory_group(
+            s, size=2, name="lg", input_proj_bias_attr=False,
+            lstm_bias_attr=False)
+        cfg = dsl.topology([out])
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    rng = np.random.RandomState(7)
+    raw = [rng.randn(3, 8).astype(np.float32)]
+    values, _ = net.forward(params, {"s": pad_batch(raw)})
+    h_seq = np.asarray(values["lg"].data)
+
+    w_names = [k for k in params if k.endswith(".w1")]
+    assert len(w_names) == 1, sorted(params)
+    w_h = np.asarray(params[w_names[0]])        # [2, 8]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    h = np.zeros(2, np.float32)
+    c = np.zeros(2, np.float32)
+    for t in range(3):
+        g = raw[0][t] + h @ w_h
+        i, f, ci, o = g[0:2], g[2:4], g[4:6], g[6:8]
+        c = sig(f) * c + sig(i) * np.tanh(ci)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(h_seq[0, t], h, atol=2e-5)
+
+
+def test_gru_group_matches_grumemory():
+    """gru_group must compute exactly what grumemory computes
+    (networks.py:907 'does exactly the same calculation') — the
+    config-equivalence test style of test_NetworkCompare.cpp."""
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.data.feeder import dense_vector_sequence
+    from paddle_tpu.v2 import networks
+
+    rng = np.random.RandomState(11)
+    raw = [rng.randn(4, 6).astype(np.float32)]
+
+    def run(use_group):
+        with config_scope():
+            s = dsl.data_layer("s", dense_vector_sequence(6))
+            if use_group:
+                out = networks.gru_group(s, size=2, name="g",
+                                         gru_bias_attr=False)
+            else:
+                out = dsl.grumemory(s, name="g", bias_attr=False)
+            cfg = dsl.topology([out])
+        net = NeuralNetwork(cfg)
+        params = net.init_params()
+        # one recurrent weight in both formulations: force them equal
+        wk = [k for k in params if k.endswith(".w0") or "gate" in k]
+        assert len(wk) == 1, sorted(params)
+        w = np.random.RandomState(3).randn(
+            *np.asarray(params[wk[0]]).shape).astype(np.float32) * 0.3
+        params = dict(params)
+        params[wk[0]] = w
+        values, _ = net.forward(params, {"s": pad_batch(raw)})
+        key = "g" if "g" in values else next(iter(values))
+        return np.asarray(values[key].data)
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-5)
+
+
+def test_dot_product_attention_forward():
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.data.feeder import dense_vector, dense_vector_sequence
+    from paddle_tpu.v2 import networks
+
+    with config_scope():
+        enc = dsl.data_layer("enc", dense_vector_sequence(4))
+        att = dsl.data_layer("att", dense_vector_sequence(5))
+        state = dsl.data_layer("state", dense_vector(4))
+        ctx = networks.dot_product_attention(
+            encoded_sequence=enc, attended_sequence=att,
+            transformed_state=state, name="att0")
+        assert ctx.size == 5          # context dim == attended dim
+        cfg = dsl.topology([ctx])
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    rng = np.random.RandomState(13)
+    e = [rng.randn(3, 4).astype(np.float32)]
+    a = [rng.randn(3, 5).astype(np.float32)]
+    st = rng.randn(1, 4).astype(np.float32)
+    values, _ = net.forward(
+        params, {"enc": pad_batch(e), "att": pad_batch(a), "state": st})
+    got = np.asarray(values[ctx.name])[0]
+    # numpy reference: w = softmax over (state·enc_t * fc_w); fc has one
+    # scalar weight on the dot product
+    fc_w = float(np.asarray([v for k, v in params.items()
+                             if "softmax" in k][0]).squeeze())
+    scores = (e[0] @ st[0]) * fc_w
+    w = np.exp(scores - scores.max()); w /= w.sum()
+    np.testing.assert_allclose(got, w @ a[0], rtol=1e-4, atol=1e-5)
+
+
+def test_img_conv_bn_pool_and_small_vgg_topology():
+    from paddle_tpu.v2 import networks
+
+    with config_scope():
+        img = dsl.data_layer("im", size=3 * 32 * 32)
+        out = networks.img_conv_bn_pool(
+            img, filter_size=3, num_filters=8, pool_size=2, pool_stride=2,
+            conv_padding=1, num_channel=3, img_size=32, name="blk")
+        assert out.size == 8 * 16 * 16
+        cfg = dsl.topology([out])
+        types = [l.type for l in cfg.layers]
+        assert types == ["data", "exconv", "batch_norm", "pool"]
+    with config_scope():
+        img = dsl.data_layer("im", size=3 * 32 * 32)
+        out = networks.small_vgg(img, num_channels=3, num_classes=10,
+                                 img_size=32)
+        assert out.size == 10
+        cfg = dsl.topology([out])
+        assert sum(1 for l in cfg.layers if l.type == "exconv") == 10
+        assert sum(1 for l in cfg.layers if l.type == "batch_norm") == 11
+
+
+def test_bidirectional_gru_and_simple_gru2_sizes():
+    from paddle_tpu.data.feeder import dense_vector_sequence
+    from paddle_tpu.v2 import networks
+
+    with config_scope():
+        s = dsl.data_layer("s", dense_vector_sequence(6))
+        g2 = networks.simple_gru2(s, size=4, name="g2")
+        assert g2.size == 4
+        bi = networks.bidirectional_gru(s, size=4, name="bi")
+        assert bi.size == 8               # last_fw ‖ first_bw
+        bi_seq = networks.bidirectional_gru(s, size=4, name="bi2",
+                                            return_seq=True)
+        assert bi_seq.size == 8
+
+
+def test_inputs_declaration_orders_input_layer_names():
+    with config_scope():
+        a = dsl.data_layer("a", size=3)
+        b = dsl.data_layer("b", size=4)
+        from paddle_tpu.v2.networks import inputs
+        inputs([b, a])
+        out = dsl.fc_layer(input=[a, b], size=2)
+        cfg = dsl.topology([out])
+        assert cfg.input_layer_names == ["b", "a"]
+
+
+def test_reference_networks_all_names_exist():
+    """networks.py:25 __all__ — every composite helper must exist."""
+    from paddle_tpu.v2 import networks
+
+    ref_all = [
+        'sequence_conv_pool', 'simple_lstm', 'simple_img_conv_pool',
+        'img_conv_bn_pool', 'lstmemory_group', 'lstmemory_unit',
+        'small_vgg', 'img_conv_group', 'vgg_16_network', 'gru_unit',
+        'gru_group', 'simple_gru', 'simple_attention',
+        'dot_product_attention', 'simple_gru2', 'bidirectional_gru',
+        'text_conv_pool', 'bidirectional_lstm', 'inputs', 'outputs',
+    ]
+    missing = [n for n in ref_all if not hasattr(networks, n)]
+    assert not missing, f"missing networks helpers: {missing}"
+
+
+def test_inputs_declaration_validates_names():
+    with config_scope():
+        a = dsl.data_layer("a", size=3)
+        from paddle_tpu.v2.networks import inputs
+        inputs([a, "bb_typo"])
+        out = dsl.fc_layer(input=[a], size=2)
+        with pytest.raises(Exception, match="bb_typo"):
+            dsl.topology([out])
+
+
+def test_bidirectional_gru_rejects_unprefixed_kwargs():
+    from paddle_tpu.data.feeder import dense_vector_sequence
+    from paddle_tpu.v2 import networks
+
+    with config_scope():
+        s = dsl.data_layer("s", dense_vector_sequence(6))
+        with pytest.raises(Exception, match="fwd_/bwd_"):
+            networks.bidirectional_gru(s, size=4, gru_bias_attr=False)
