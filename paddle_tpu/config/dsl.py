@@ -904,16 +904,18 @@ pooling_layer = pooling
 
 
 def last_seq(input: Input, name: Optional[str] = None, agg_level=None,
-             stride: int = -1) -> LayerOutput:
+             stride: int = -1, layer_attr=None) -> LayerOutput:
     inp = _as_list(input)[0]
     return _add_layer(name, "seqlastins", inp.size, _mk_inputs([inp]),
-                      None, False, {"stride": stride})
+                      None, False, {"stride": stride},
+                      layer_attr=layer_attr)
 
 
 def first_seq(input: Input, name: Optional[str] = None,
-              agg_level=None) -> LayerOutput:
+              agg_level=None, layer_attr=None) -> LayerOutput:
     inp = _as_list(input)[0]
-    return _add_layer(name, "seqfirstins", inp.size, _mk_inputs([inp]))
+    return _add_layer(name, "seqfirstins", inp.size, _mk_inputs([inp]),
+                      layer_attr=layer_attr)
 
 
 def expand(input: Input, expand_as: LayerOutput, name: Optional[str] = None,

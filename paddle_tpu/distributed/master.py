@@ -159,9 +159,10 @@ def _unescape_payload(s: str) -> str:
 class MasterClient:
     """TCP client speaking the master's line protocol (remote trainers)."""
 
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, timeout: float = 30.0):
         host, port = addr.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
         self._buf = b""
 
     def _call(self, line: str) -> str:

@@ -7,14 +7,17 @@ with an import swap: ``layer``, ``activation``, ``pooling``, ``attr``,
 """
 
 from . import activation, attr, data_type, dataset, evaluator, event
-from . import inference, layer, networks, optimizer, pooling, reader, trainer
+from . import image, inference, layer, master, model, networks, optimizer
+from . import plot, pooling, reader, topology, trainer
+from . import parameters
 from .inference import infer
 from .parameters import Parameters
 
 __all__ = [
     "activation", "attr", "data_type", "dataset", "evaluator", "event",
-    "inference", "infer", "layer", "networks", "optimizer", "pooling",
-    "reader", "trainer", "Parameters", "init",
+    "image", "inference", "infer", "layer", "master", "model", "networks",
+    "optimizer", "parameters", "plot", "pooling", "reader", "topology",
+    "trainer", "Parameters", "init",
 ]
 
 

@@ -354,8 +354,12 @@ def bidirectional_gru(input, size: int, name=None,
     bw = simple_gru2(input, size, name=f"{name}_bw", reverse=True,
                      **bwd_kw)
     if return_seq:
-        return concat([fw, bw], act=kw.get("concat_act"))
-    return concat([last_seq(fw), first_seq(bw)], act=kw.get("concat_act"))
+        return concat([fw, bw], act=kw.get("concat_act"),
+                      layer_attr=kw.get("concat_attr"))
+    return concat([last_seq(fw, layer_attr=kw.get("last_seq_attr")),
+                   first_seq(bw, layer_attr=kw.get("first_seq_attr"))],
+                  act=kw.get("concat_act"),
+                  layer_attr=kw.get("concat_attr"))
 
 
 def dot_product_attention(encoded_sequence, attended_sequence,

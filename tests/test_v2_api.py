@@ -193,3 +193,74 @@ def test_config_declared_evaluators_run_in_test_job(tmp_path):
     assert "classification_error" in metrics
     assert 0.0 <= metrics["classification_error"] <= 1.0
     assert "sum" in metrics or any("sum" in k for k in metrics)
+
+
+def test_v2_namespace_parity():
+    """Reference python/paddle/v2/__init__.py __all__ — every module."""
+    import paddle_tpu.v2 as v2
+
+    ref_all = ['optimizer', 'layer', 'activation', 'parameters', 'init',
+               'trainer', 'event', 'data_type', 'attr', 'pooling',
+               'dataset', 'reader', 'topology', 'networks', 'infer',
+               'plot', 'evaluator', 'image', 'master', 'model']
+    missing = [n for n in ref_all if not hasattr(v2, n)]
+    assert not missing, f"missing v2 modules: {missing}"
+
+
+def test_v2_topology_wrapper():
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.config import dsl
+    from paddle_tpu.v2.topology import Topology
+
+    with config_scope():
+        a = dsl.data_layer("a", size=4)
+        out = dsl.fc_layer(input=[a], size=2, name="out")
+        topo = Topology(out)
+        assert topo.proto().output_layer_names == ["out"]
+        assert list(topo.data_layers()) == ["a"]
+        assert topo.get_layer_proto("out").size == 2
+        assert topo.get_layer_proto("nope") is None
+
+
+def test_v2_model_save_load_with_election(tmp_path):
+    import os
+
+    import numpy as np
+
+    from paddle_tpu.distributed import Master
+    from paddle_tpu.v2 import model
+    from paddle_tpu.v2.parameters import Parameters
+
+    params = Parameters()
+    params["w"] = np.arange(6, dtype=np.float32).reshape(2, 3)
+    # no master: plain save
+    p = model.save_model(params, str(tmp_path / "m.tar"))
+    assert p and os.path.exists(p)
+    loaded = Parameters()
+    loaded["w"] = np.zeros((2, 3), np.float32)
+    model.load_model(loaded, p)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.asarray(params["w"]))
+    # with master: exactly one of two trainers wins the election
+    # (distinct trainer ids; the same id re-asking keeps winning)
+    m = Master(timeout_s=5, failure_max=3)
+    wins = []
+    for tid in ("trainer-a", "trainer-b"):
+        model.trainer_id = tid
+        wins.append(model.save_model(params, str(tmp_path / "dist"),
+                                     master=m))
+    assert sum(1 for w in wins if w) == 1
+
+
+def test_v2_master_client_tcp():
+    from paddle_tpu.distributed import Master
+    from paddle_tpu.v2 import master as v2_master
+
+    m = Master(timeout_s=5, failure_max=3)
+    port = m.serve(0)
+    c = v2_master.client(f"127.0.0.1:{port}", timeout_sec=5.0)
+    c.set_dataset(["t0", "t1"])
+    tid, payload = c.get_task()
+    assert payload in ("t0", "t1")
+    c.task_finished(tid)
+    c.close()
