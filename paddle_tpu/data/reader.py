@@ -285,3 +285,53 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
         yield from inner()
 
     return buffered(reader, buf_size)
+
+
+def mix_readers(readers, ratios=None, main: int = 0) -> Reader:
+    """Ratio-weighted mixing of sample streams — the
+    ``MultiDataProvider`` capability (``MultiDataProvider.cpp:79-117``):
+    each pass interleaves samples from every reader in proportion to its
+    ratio; the pass ends when the *main* reader is exhausted, while the
+    other readers restart transparently (the reference resets non-main
+    sub-providers mid-pass).
+
+    :param readers: list of readers.
+    :param ratios: per-reader positive weights (``data_ratio``);
+        defaults to uniform.
+    :param main: index of the main reader (``is_main_data``).
+    """
+    ratios = list(ratios) if ratios is not None else [1.0] * len(readers)
+    if len(ratios) != len(readers):
+        raise ValueError("mix_readers: one ratio per reader required")
+    if any(r <= 0 for r in ratios):
+        raise ValueError("mix_readers: ratios must be positive")
+
+    def reader():
+        iters = [iter(r()) for r in readers]
+        # error-accumulator interleave: at every step pull from the
+        # stream whose emitted count is furthest below its ratio share;
+        # shares are maintained incrementally (O(n_readers) per sample,
+        # no per-sample re-summation)
+        counts = [0] * len(readers)
+        total = sum(ratios)
+        shares = [r / total for r in ratios]
+        step = 0
+        while True:
+            step += 1
+            i = max(range(len(readers)),
+                    key=lambda j: shares[j] * step - counts[j])
+            try:
+                sample = next(iters[i])
+            except StopIteration:
+                if i == main:
+                    return            # main stream exhausted: pass ends
+                iters[i] = iter(readers[i]())   # non-main: restart
+                try:
+                    sample = next(iters[i])
+                except StopIteration:
+                    raise ValueError(
+                        f"mix_readers: reader {i} is empty")
+            counts[i] += 1
+            yield i, sample
+
+    return reader

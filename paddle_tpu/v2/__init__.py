@@ -12,12 +12,13 @@ from . import plot, pooling, reader, topology, trainer
 from . import parameters
 from .inference import infer
 from .parameters import Parameters
+from .reader import batch  # paddle.batch (v2/minibatch.py alias)
 
 __all__ = [
     "activation", "attr", "data_type", "dataset", "evaluator", "event",
     "image", "inference", "infer", "layer", "master", "model", "networks",
     "optimizer", "parameters", "plot", "pooling", "reader", "topology",
-    "trainer", "Parameters", "init",
+    "trainer", "Parameters", "batch", "init",
 ]
 
 

@@ -22,5 +22,8 @@ def client(addr: Optional[str] = None, timeout_sec: float = 5.0,
     ``buf_size`` is unused — buffering lives in the reader combinators
     (``buffered()``), not the client."""
     if addr is None:
-        return Master(timeout_s=max(timeout_sec, 1.0), failure_max=3)
+        # timeout_sec is a CONNECTION timeout in the reference API; the
+        # in-process master's lease timeout keeps its own default (60s,
+        # go/master/service.go task re-dispatch semantics)
+        return Master(timeout_s=60.0, failure_max=3)
     return MasterClient(addr, timeout=timeout_sec)

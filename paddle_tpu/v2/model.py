@@ -19,14 +19,21 @@ trainer_id = str(uuid.uuid4())
 
 
 def save_model(parameters, path: str, master=None,
-               interval_s: float = 60.0) -> Optional[str]:
+               interval_s: float = 60.0,
+               trainer: Optional[str] = None) -> Optional[str]:
     """Write ``parameters`` to ``path``; with a ``master`` handle, only
     the elected trainer writes (returns None on the losers, the written
-    path on the winner)."""
+    path on the winner).
+
+    ``trainer`` defaults to the per-process uuid — distinct across
+    trainer *processes* (the reference deployment unit); in-process
+    multi-trainer callers must pass distinct ids or they all win the
+    election and race on the same file."""
+    tid = trainer or trainer_id
     if master is not None:
-        if not master.request_save_model(trainer_id, interval_s):
+        if not master.request_save_model(tid, interval_s):
             return None
-        path = os.path.join(path, trainer_id, "model.tar")
+        path = os.path.join(path, tid, "model.tar")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
         parameters.to_tar(f)

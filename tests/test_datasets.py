@@ -268,3 +268,28 @@ def test_cloud_reader_with_master(tmp_path):
     # re-iterable across passes: the reader re-arms the epoch
     assert sorted(list(r())) == sorted(samples)
     assert sorted(list(r())) == sorted(samples)
+
+
+def test_mix_readers_ratios_and_main_exhaustion():
+    """MultiDataProvider semantics (MultiDataProvider.cpp:79-117):
+    ratio-proportional interleave, the pass ends with the MAIN stream,
+    non-main streams restart mid-pass."""
+    from paddle_tpu.data.reader import mix_readers
+
+    main = lambda: iter(range(100, 106))            # 6 samples
+    side = lambda: iter(["a", "b"])                 # 2, restarts
+    r = mix_readers([main, side], ratios=[3.0, 1.0], main=0)
+    got = list(r())
+    by_stream = {0: [], 1: []}
+    for i, s in got:
+        by_stream[i].append(s)
+    # main fully consumed exactly once, ~3:1 interleave
+    assert by_stream[0] == [100, 101, 102, 103, 104, 105]
+    assert len(by_stream[1]) == 2                   # 6/3 = 2 side samples
+    assert all(s in ("a", "b") for s in by_stream[1])
+    # side stream restarted if more is needed: heavier side ratio
+    r2 = mix_readers([main, side], ratios=[1.0, 2.0], main=0)
+    n_side = sum(1 for i, _ in r2() if i == 1)
+    assert n_side > 2                               # restarted at least once
+    with pytest.raises(ValueError, match="ratio"):
+        mix_readers([main], ratios=[1.0, 2.0])

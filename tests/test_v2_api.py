@@ -244,11 +244,9 @@ def test_v2_model_save_load_with_election(tmp_path):
     # with master: exactly one of two trainers wins the election
     # (distinct trainer ids; the same id re-asking keeps winning)
     m = Master(timeout_s=5, failure_max=3)
-    wins = []
-    for tid in ("trainer-a", "trainer-b"):
-        model.trainer_id = tid
-        wins.append(model.save_model(params, str(tmp_path / "dist"),
-                                     master=m))
+    wins = [model.save_model(params, str(tmp_path / "dist"), master=m,
+                             trainer=tid)
+            for tid in ("trainer-a", "trainer-b")]
     assert sum(1 for w in wins if w) == 1
 
 
