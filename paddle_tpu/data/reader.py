@@ -305,6 +305,10 @@ def mix_readers(readers, ratios=None, main: int = 0) -> Reader:
         raise ValueError("mix_readers: one ratio per reader required")
     if any(r <= 0 for r in ratios):
         raise ValueError("mix_readers: ratios must be positive")
+    if not 0 <= main < len(readers):
+        raise ValueError(
+            f"mix_readers: main index {main} out of range for "
+            f"{len(readers)} readers")
 
     def reader():
         iters = [iter(r()) for r in readers]

@@ -158,16 +158,19 @@ def modified_huber_loss(x, label):
 def rank_loss(left, right, label):
     """Pairwise rank cost (``RankingCost``, ``rank_loss_op.cc``):
     CE of sigmoid(left-right) against label in [0,1]."""
-    o = (left - right).reshape(-1)
-    lab = label.reshape(-1).astype(o.dtype)
+    o = left - right
+    lab = label.astype(o.dtype).reshape(o.shape)
+    # output keeps Left's shape ([B,1]), as rank_loss_op InferShape does
     return jnp.maximum(o, 0) - o * lab + jnp.log1p(jnp.exp(-jnp.abs(o)))
 
 
 @register_op("margin_rank_loss")
 def margin_rank_loss(x1, x2, label, margin: float = 0.0):
-    """max(0, -label*(x1-x2) + margin) (``margin_rank_loss_op.cc``)."""
-    return jnp.maximum(
-        0.0, -label.reshape(-1) * (x1 - x2).reshape(-1) + margin)
+    """max(0, -label*(x1-x2) + margin) (``margin_rank_loss_op.cc``);
+    output keeps X1's shape ([B,1]) per the op's InferShape."""
+    o = x1 - x2
+    return jnp.maximum(0.0, -label.astype(o.dtype).reshape(o.shape) * o
+                       + margin)
 
 
 @register_op("lambda_cost")

@@ -293,3 +293,13 @@ def test_mix_readers_ratios_and_main_exhaustion():
     assert n_side > 2                               # restarted at least once
     with pytest.raises(ValueError, match="ratio"):
         mix_readers([main], ratios=[1.0, 2.0])
+
+
+def test_mix_readers_validates_main_index():
+    from paddle_tpu.data.reader import mix_readers
+
+    r = lambda: iter([1])
+    with pytest.raises(ValueError, match="main index"):
+        mix_readers([r, r], main=2)
+    with pytest.raises(ValueError, match="main index"):
+        mix_readers([r, r], main=-1)

@@ -1,0 +1,388 @@
+"""Generic op test harness over the framework op registry.
+
+The reference drives every operator through one harness
+(``python/paddle/v2/framework/tests/op_test.py``): run the op from numpy
+inputs, ``check_output_with_place:231`` against a python reference, and
+``check_grad:338`` — the framework's gradient vs
+``get_numeric_gradient:80`` central differences.  Here the "framework
+gradient" is jax autodiff through the registered op body (what the
+Executor's backward actually uses), checked against finite differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.ops import OPS, OpContext
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+def _run(op_type, ins, attrs=None, out_slot="Out", is_test=True):
+    ctx = OpContext(is_test=is_test, rng=jax.random.PRNGKey(0))
+    jins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    outs = OPS[op_type](ctx, jins, attrs or {})
+    return [np.asarray(v.data if isinstance(v, SequenceBatch) else v)
+            for v in outs[out_slot]]
+
+
+def check_output(op_type, ins, ref, attrs=None, out_slot="Out",
+                 rtol=1e-5, atol=1e-6):
+    got = _run(op_type, ins, attrs, out_slot)[0]
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol,
+                               err_msg=f"{op_type} forward mismatch")
+
+
+def check_grad(op_type, ins, grad_slots, attrs=None, out_slot="Out",
+               eps=1e-3, rtol=2e-2, atol=5e-3):
+    """Autodiff-through-the-op vs central finite differences on a fixed
+    weighted sum of the op outputs (op_test.py check_grad:338)."""
+    attrs = attrs or {}
+    keys = [(slot, i) for slot in grad_slots
+            for i in range(len(ins[slot]))]
+    # contiguous copies: the FD loop mutates through a flat view, which
+    # silently fails to alias on non-contiguous inputs
+    x0 = [np.array(ins[s][i], np.float32) for s, i in keys]
+
+    def loss(*arrs):
+        jins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+        for (slot, i), a in zip(keys, arrs):
+            jins[slot][i] = a
+        ctx = OpContext(is_test=True, rng=jax.random.PRNGKey(0))
+        outs = OPS[op_type](ctx, jins, attrs)[out_slot]
+        total = 0.0
+        for oi, o in enumerate(outs):
+            v = o.data if isinstance(o, SequenceBatch) else o
+            # fixed deterministic cotangent — not all-ones, so sign
+            # errors in per-element grads can't cancel
+            w = (np.arange(v.size, dtype=np.float32).reshape(v.shape)
+                 % 7 + 1.0) / 7.0
+            total = total + jnp.sum(v.astype(jnp.float32) * w)
+        return total
+
+    auto = jax.grad(loss, argnums=tuple(range(len(keys))))(
+        *[jnp.asarray(x) for x in x0])
+    for ki in range(len(keys)):
+        fd = np.zeros_like(x0[ki])
+        flat = x0[ki].reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            args = [jnp.asarray(x) for x in x0]
+            flat[j] = orig + eps
+            args[ki] = jnp.asarray(x0[ki])
+            up = float(loss(*args))
+            flat[j] = orig - eps
+            args[ki] = jnp.asarray(x0[ki])
+            dn = float(loss(*args))
+            flat[j] = orig
+            fd.reshape(-1)[j] = (up - dn) / (2 * eps)
+        np.testing.assert_allclose(
+            np.asarray(auto[ki]), fd, rtol=rtol, atol=atol,
+            err_msg=f"{op_type} grad mismatch on {keys[ki]}")
+
+
+R = np.random.RandomState(1234)
+
+
+def _x(*shape, lo=-2.0, hi=2.0, away_from=(), margin=0.15):
+    """Uniform sample avoiding FD-hostile kink points."""
+    x = R.uniform(lo, hi, shape).astype(np.float32)
+    for p in away_from:
+        close = np.abs(x - p) < margin
+        x = np.where(close, x + np.sign(x - p + 1e-9) * margin * 2, x)
+    return x.astype(np.float32)
+
+
+def _np_softmax(z, axis=-1):
+    e = np.exp(z - z.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+# ------------------------------------------------ activation family
+# (name, numpy reference, attrs, kink points to avoid in FD)
+ACT_CASES = [
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), {}, ()),
+    ("tanh", np.tanh, {}, ()),
+    ("relu", lambda x: np.maximum(x, 0), {}, (0.0,)),
+    ("exp", np.exp, {}, ()),
+    ("abs", np.abs, {}, (0.0,)),
+    ("square", np.square, {}, ()),
+    ("softplus", lambda x: np.log1p(np.exp(x)), {}, ()),
+    ("softsign", lambda x: x / (1 + np.abs(x)), {}, (0.0,)),
+    ("logsigmoid", lambda x: -np.log1p(np.exp(-x)), {}, ()),
+    ("leaky_relu", lambda x: np.where(x >= 0, x, 0.02 * x),
+     {"alpha": 0.02}, (0.0,)),
+    ("elu", lambda x: np.where(x >= 0, x, 1.0 * (np.exp(x) - 1)),
+     {"alpha": 1.0}, (0.0,)),
+    ("brelu", lambda x: np.clip(x, -1.0, 1.5),
+     {"t_min": -1.0, "t_max": 1.5}, (-1.0, 1.5)),
+    ("relu6", lambda x: np.clip(x, 0, 6.0), {}, (0.0, 6.0)),
+    ("soft_relu", lambda x: np.log1p(np.exp(np.clip(x, -40, 40))), {}, ()),
+    ("stanh", lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x),
+     {"scale_a": 2.0 / 3.0, "scale_b": 1.7159}, ()),
+    ("tanh_shrink", lambda x: x - np.tanh(x), {}, ()),
+    ("softshrink", lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0)),
+     {"lambda": 0.5}, (-0.5, 0.5)),
+    ("hard_shrink", lambda x: np.where(np.abs(x) > 0.5, x, 0),
+     {"threshold": 0.5}, (-0.5, 0.5)),
+    ("thresholded_relu", lambda x: np.where(x > 1.0, x, 0),
+     {"threshold": 1.0}, (1.0,)),
+    ("hard_sigmoid",
+     lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+     {"slope": 0.2, "offset": 0.5}, (-2.5, 2.5)),
+]
+
+
+@pytest.mark.parametrize("name,ref,attrs,kinks",
+                         ACT_CASES, ids=[c[0] for c in ACT_CASES])
+def test_activation_op(name, ref, attrs, kinks):
+    x = _x(3, 5, away_from=kinks)
+    check_output(name, {"X": [x]}, ref(x), attrs, rtol=1e-4, atol=1e-5)
+    check_grad(name, {"X": [x]}, ["X"], attrs)
+
+
+def test_positive_domain_activations():
+    x = _x(3, 4, lo=0.3, hi=3.0)
+    check_output("log", {"X": [x]}, np.log(x), rtol=1e-5)
+    check_grad("log", {"X": [x]}, ["X"])
+    check_output("sqrt", {"X": [x]}, np.sqrt(x), rtol=1e-5)
+    check_grad("sqrt", {"X": [x]}, ["X"])
+    check_output("reciprocal", {"X": [x]}, 1.0 / x, rtol=1e-4)
+    check_grad("reciprocal", {"X": [x]}, ["X"])
+    check_output("pow", {"X": [x]}, x ** 2.5, {"factor": 2.5}, rtol=1e-4)
+    check_grad("pow", {"X": [x]}, ["X"], {"factor": 2.5})
+
+
+def test_sign_output_only():
+    x = _x(4, 4, away_from=(0.0,))
+    check_output("sign", {"X": [x]}, np.sign(x))
+
+
+# ------------------------------------------------ elementwise / math
+def test_elementwise_ops():
+    x, y = _x(3, 4), _x(3, 4)
+    yp = _x(3, 4, lo=0.5, hi=2.0)
+    for name, ref, yy in [("elementwise_add", x + y, y),
+                          ("elementwise_sub", x - y, y),
+                          ("elementwise_mul", x * y, y),
+                          ("elementwise_div", x / yp, yp)]:
+        check_output(name, {"X": [x], "Y": [yy]}, ref, rtol=1e-5)
+        check_grad(name, {"X": [x], "Y": [yy]}, ["X", "Y"])
+
+
+def test_mul_and_matmul():
+    x, y = _x(3, 4), _x(4, 5)
+    check_output("mul", {"X": [x], "Y": [y]}, x @ y, rtol=1e-5)
+    check_grad("mul", {"X": [x], "Y": [y]}, ["X", "Y"])
+    check_output("matmul", {"X": [x], "Y": [y.T.copy()]}, x @ y,
+                 {"transpose_Y": True}, rtol=1e-5)
+    check_grad("matmul", {"X": [x], "Y": [y.T.copy()]}, ["X", "Y"],
+               {"transpose_Y": True})
+
+
+def test_sum_mean_minus_scale_clip():
+    a, b, c = _x(2, 3), _x(2, 3), _x(2, 3)
+    check_output("sum", {"X": [a, b, c]}, a + b + c, rtol=1e-5)
+    check_grad("sum", {"X": [a, b, c]}, ["X"])
+    check_output("mean", {"X": [a]}, a.mean(), rtol=1e-5)
+    check_grad("mean", {"X": [a]}, ["X"])
+    check_output("minus", {"X": [a], "Y": [b]}, a - b)
+    check_output("scale", {"X": [a]}, a * 3.0, {"scale": 3.0})
+    check_grad("scale", {"X": [a]}, ["X"], {"scale": 3.0})
+    xc = _x(3, 4, away_from=(-1.0, 1.0))
+    check_output("clip", {"X": [xc]}, np.clip(xc, -1, 1),
+                 {"min": -1.0, "max": 1.0})
+    check_grad("clip", {"X": [xc]}, ["X"], {"min": -1.0, "max": 1.0})
+
+
+def test_reduce_ops():
+    x = _x(3, 4, 2)
+    for name, ref in [("reduce_sum", x.sum(1)), ("reduce_mean", x.mean(1)),
+                      ("reduce_max", x.max(1)), ("reduce_min", x.min(1))]:
+        check_output(name, {"X": [x]}, ref, {"dim": 1}, rtol=1e-5)
+    check_grad("reduce_sum", {"X": [x]}, ["X"], {"dim": 1})
+    check_grad("reduce_mean", {"X": [x]}, ["X"], {"dim": 1})
+
+
+def test_shape_glue_ops():
+    x = _x(2, 6)
+    check_output("reshape", {"X": [x]}, x.reshape(3, 4), {"shape": [3, 4]})
+    check_grad("reshape", {"X": [x]}, ["X"], {"shape": [3, 4]})
+    x3 = _x(2, 3, 4)
+    check_output("transpose", {"X": [x3]}, x3.transpose(2, 0, 1),
+                 {"axis": [2, 0, 1]})
+    check_grad("transpose", {"X": [x3]}, ["X"], {"axis": [2, 0, 1]})
+    a, b = _x(2, 3), _x(2, 5)
+    check_output("concat", {"X": [a, b]}, np.concatenate([a, b], 1),
+                 {"axis": 1})
+    check_grad("concat", {"X": [a, b]}, ["X"], {"axis": 1})
+    x = _x(2, 4)
+    check_output("pad", {"X": [x]}, np.pad(x, [(0, 1), (2, 0)],
+                                           constant_values=1.5),
+                 {"paddings": [0, 1, 2, 0], "pad_value": 1.5})
+    check_grad("pad", {"X": [x]}, ["X"],
+               {"paddings": [0, 1, 2, 0], "pad_value": 1.5})
+    x = _x(4, 5)
+    check_output("crop", {"X": [x]}, x[1:3, 2:5],
+                 {"offsets": [1, 2], "shape": [2, 3]})
+    check_grad("crop", {"X": [x]}, ["X"],
+               {"offsets": [1, 2], "shape": [2, 3]})
+
+
+def test_gather_scatter_multiplex_topk():
+    x = _x(5, 3)
+    idx = np.array([3, 1, 1], np.int32)
+    check_output("gather", {"X": [x], "Index": [idx]}, x[idx])
+    check_grad("gather", {"X": [x], "Index": [idx]}, ["X"])
+    ref = x.copy()
+    upd = _x(2, 3)
+    ref[np.array([0, 2])] = upd       # reference scatter_op SETS rows
+    check_output("scatter", {"Ref": [x], "Index": [np.array([0, 2],
+                                                            np.int32)],
+                             "Updates": [upd]}, ref)
+    a, b = _x(4, 3), _x(4, 3)
+    ids = np.array([[0], [1], [0], [1]], np.int32)
+    want = np.where(ids == 0, a, b)
+    check_output("multiplex", {"Ids": [ids], "X": [a, b]}, want)
+    x = _x(3, 6)
+    check_output("top_k", {"X": [x]}, np.sort(x, 1)[:, :-3:-1], {"k": 2})
+
+
+def test_fill_and_cast_ops():
+    x = _x(3, 2)
+    check_output("fill_zeros_like", {"X": [x]}, np.zeros_like(x))
+    check_output("fill_constant", {"X": []}, np.full((2, 3), 1.25,
+                                                     np.float32),
+                 {"shape": [2, 3], "value": 1.25})
+    check_output("fill_constant_batch_size_like", {"Input": [x]},
+                 np.full((3, 4), 2.0, np.float32),
+                 {"shape": [9, 4], "value": 2.0})
+    got = _run("cast", {"X": [x]}, {"dtype": "int32"})[0]
+    assert got.dtype == np.int32
+    check_output("increment", {"X": [x]}, x + 1.0, {"step": 1.0})
+
+
+def test_cos_sim_and_conv_shift():
+    x, y = _x(4, 6), _x(4, 6)
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                             * np.linalg.norm(y, axis=1))
+    check_output("cos_sim", {"X": [x], "Y": [y]}, want.reshape(-1, 1),
+                 rtol=1e-4)
+    check_grad("cos_sim", {"X": [x], "Y": [y]}, ["X", "Y"])
+    x, k = _x(2, 7), _x(2, 3)
+    ref = np.stack([[sum(x[b, (i + j - 1) % 7] * k[b, j]
+                         for j in range(3)) for i in range(7)]
+                    for b in range(2)])
+    check_output("conv_shift", {"X": [x], "Y": [k]}, ref, rtol=1e-4)
+    check_grad("conv_shift", {"X": [x], "Y": [k]}, ["X", "Y"])
+
+
+# ------------------------------------------------ NN ops
+def test_conv2d_op_grad():
+    x = _x(2, 3, 6, 6)                  # NCHW, reference layout
+    w = _x(4, 3, 3, 3) * 0.5
+    got = _run("conv2d", {"Input": [x], "Filter": [w]},
+               {"strides": [1, 1], "paddings": [1, 1]},
+               out_slot="Output")[0]
+    assert got.shape == (2, 4, 6, 6)
+    check_grad("conv2d", {"Input": [x[:1, :, :4, :4]],
+                          "Filter": [w[:2]]},
+               ["Input", "Filter"],
+               {"strides": [1, 1], "paddings": [1, 1]},
+               out_slot="Output", rtol=5e-2, atol=1e-2)
+
+
+def test_pool2d_op():
+    x = _x(1, 2, 4, 4)
+    got = _run("pool2d", {"X": [x]},
+               {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                "pooling_type": "max"})[0]
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    check_grad("pool2d", {"X": [_x(1, 1, 4, 4, away_from=())]}, ["X"],
+               {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                "pooling_type": "avg"})
+
+
+def test_lookup_table_grad():
+    w = _x(10, 4)
+    ids = np.array([[1], [3], [3], [7]], np.int64)
+    got = _run("lookup_table", {"W": [w], "Ids": [ids]})[0]
+    np.testing.assert_allclose(got, w[ids[:, 0]], rtol=1e-6)
+    check_grad("lookup_table", {"W": [w], "Ids": [ids]}, ["W"])
+
+
+# ------------------------------------------------ losses
+def test_loss_ops():
+    p = _np_softmax(_x(4, 5)).astype(np.float32)
+    lab = np.array([[0], [2], [4], [1]], np.int64)
+    check_output("cross_entropy", {"X": [p], "Label": [lab]},
+                 -np.log(p[np.arange(4), lab[:, 0]]).reshape(-1, 1),
+                 out_slot="Y", rtol=1e-4)
+    check_grad("cross_entropy", {"X": [p], "Label": [lab]}, ["X"],
+               out_slot="Y")
+
+    z = _x(4, 5)
+    soft = _np_softmax(z)
+    check_output("softmax_with_cross_entropy",
+                 {"Logits": [z], "Label": [lab]},
+                 -np.log(soft[np.arange(4), lab[:, 0]]).reshape(-1, 1),
+                 out_slot="Loss", rtol=1e-4)
+    check_grad("softmax_with_cross_entropy",
+               {"Logits": [z], "Label": [lab]}, ["Logits"],
+               out_slot="Loss")
+
+    x = _x(3, 4)
+    t = (R.rand(3, 4) > 0.5).astype(np.float32)
+    want = np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))
+    check_output("sigmoid_cross_entropy_with_logits",
+                 {"X": [x], "Label": [t]}, want, rtol=1e-4)
+    check_grad("sigmoid_cross_entropy_with_logits",
+               {"X": [x], "Label": [t]}, ["X"])
+
+    a, b = _x(4, 3), _x(4, 3)
+    check_output("squared_l2_distance", {"X": [a], "Y": [b]},
+                 ((a - b) ** 2).sum(1).reshape(-1, 1), rtol=1e-4)
+    check_grad("squared_l2_distance", {"X": [a], "Y": [b]}, ["X", "Y"])
+    check_output("squared_l2_norm", {"X": [a]}, (a ** 2).sum(), rtol=1e-4)
+    check_grad("squared_l2_norm", {"X": [a]}, ["X"])
+    xl = _x(3, 4, away_from=(0.0,))
+    check_output("l1_norm", {"X": [xl]}, np.abs(xl).sum(), rtol=1e-4)
+    check_grad("l1_norm", {"X": [xl]}, ["X"])
+
+
+def test_rank_losses():
+    l, r = _x(5, 1), _x(5, 1)
+    lab = (R.rand(5, 1) > 0.5).astype(np.float32)
+    o = l - r
+    want = np.log1p(np.exp(o)) - lab * o
+    check_output("rank_loss", {"Left": [l], "Right": [r], "Label": [lab]},
+                 want, rtol=1e-4)
+    check_grad("rank_loss", {"Left": [l], "Right": [r], "Label": [lab]},
+               ["Left", "Right"])
+    lab2 = np.where(lab > 0, 1.0, -1.0).astype(np.float32)
+    m = 0.1
+    want2 = np.maximum(0, -lab2 * (l - r) + m)
+    # avoid the hinge kink for FD
+    mask = np.abs(-lab2 * (l - r) + m) < 0.1
+    if not mask.any():
+        check_grad("margin_rank_loss",
+                   {"X1": [l], "X2": [r], "Label": [lab2]},
+                   ["X1", "X2"], {"margin": m})
+    check_output("margin_rank_loss",
+                 {"X1": [l], "X2": [r], "Label": [lab2]}, want2,
+                 {"margin": m}, rtol=1e-4)
+
+
+def test_dropout_test_mode_and_metrics():
+    x = _x(3, 4)
+    got = _run("dropout", {"X": [x]}, {"dropout_prob": 0.5,
+                                       "is_test": True})[0]
+    np.testing.assert_allclose(got, x)
+    pred = _np_softmax(_x(6, 3)).astype(np.float32)
+    lab = np.argmax(pred, 1).reshape(-1, 1)
+    lab[0] = (lab[0] + 1) % 3           # one wrong
+    acc = _run("accuracy", {"Out": [pred], "Label": [lab]},
+               {}, out_slot="Accuracy")[0]
+    np.testing.assert_allclose(float(acc), 5.0 / 6.0, rtol=1e-6)
