@@ -193,6 +193,10 @@ def config_namespace() -> Dict[str, Any]:
             ns[k] = getattr(_networks, k)
     from . import layer_math
     ns["layer_math"] = layer_math
+    # trainer/recurrent_units.py helpers (v1 config-parser level)
+    from . import recurrent_units as _ru
+    for k in _ru.__all__:
+        ns.setdefault(k, getattr(_ru, k))
     from ..data import feeder
     for k in ("dense_vector", "integer_value", "integer_value_sequence",
               "sparse_binary_vector", "sparse_float_vector",

@@ -119,10 +119,12 @@ class ParamAttr:
 
 @dataclass
 class ExtraAttr:
-    """ExtraLayerAttribute: drop_rate, device (→ sharding hint)."""
+    """ExtraLayerAttribute: drop_rate, device (→ sharding hint),
+    error_clipping_threshold (backward error clip)."""
 
     drop_rate: float = 0.0
     device: int = -1
+    error_clipping_threshold: float = 0.0
 
 
 # -------------------------------------------------------------- pooling
@@ -278,6 +280,8 @@ def _add_layer(name: Optional[str], ltype: str, size: int,
                              else ""),
         drop_rate=layer_attr.drop_rate if layer_attr else 0.0,
         device=layer_attr.device if layer_attr else -1,
+        error_clipping_threshold=(layer_attr.error_clipping_threshold
+                                  if layer_attr else 0.0),
         attrs=attrs or {})
     _collector.add(conf)
     if param_attrs:
@@ -766,7 +770,8 @@ def grumemory(input: Input, name: Optional[str] = None, reverse: bool = False,
 def gru_step_layer(input: Input, output_mem: LayerOutput,
                    size: Optional[int] = None, act=None, gate_act=None,
                    name: Optional[str] = None, bias_attr=True,
-                   param_attr: Optional[ParamAttr] = None) -> LayerOutput:
+                   param_attr: Optional[ParamAttr] = None,
+                   layer_attr=None) -> LayerOutput:
     """One GRU step for use inside recurrent groups (``GruStepLayer``);
     inputs: 3H projection of x, previous state (a memory link)."""
     inp = _as_list(input)[0]
@@ -778,13 +783,13 @@ def gru_step_layer(input: Input, output_mem: LayerOutput,
                       _mk_inputs([inp, output_mem], pas),
                       act or TanhActivation(), bias_attr,
                       {"active_gate_type": _act_name(gate_act)
-                       or "sigmoid"}, None, pas)
+                       or "sigmoid"}, layer_attr, pas)
 
 
 def lstm_step_layer(input: Input, state: LayerOutput,
                     size: Optional[int] = None, act=None, gate_act=None,
                     state_act=None, name: Optional[str] = None,
-                    bias_attr=True) -> LayerOutput:
+                    bias_attr=True, layer_attr=None) -> LayerOutput:
     """One LSTM step (``LstmStepLayer``); inputs: 4H projection, prev
     cell state.  Extra output ``.state`` is the new cell."""
     inp = _as_list(input)[0]
@@ -793,7 +798,7 @@ def lstm_step_layer(input: Input, state: LayerOutput,
                       act or TanhActivation(), bias_attr,
                       {"active_gate_type": _act_name(gate_act) or "sigmoid",
                        "active_state_type": _act_name(state_act)
-                       or "tanh"})
+                       or "tanh"}, layer_attr)
 
 
 def recurrent(input: Input, act=None, bias_attr=True,

@@ -97,6 +97,9 @@ class LayerConfig:
     bias_parameter_name: str = ""
     with_bias: bool = False
     drop_rate: float = 0.0
+    # clip the output-gradient (error) to ±t in backward (Layer.cpp
+    # backwardActivation error clipping); 0 = off
+    error_clipping_threshold: float = 0.0
     # free-form per-type attributes (pool type, conv geometry, context, ...)
     attrs: Dict[str, Any] = field(default_factory=dict)
     # device hint (--parallel_nn per-layer placement → sharding annotation)

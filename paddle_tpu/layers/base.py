@@ -18,6 +18,7 @@ per-layer folded PRNG key — both threaded by the NeuralNetwork.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -113,7 +114,30 @@ class Layer:
 
     def finalize(self, out: Any, ctx: ForwardContext) -> Any:
         """Activation then dropout, matching Layer::forwardActivation order."""
-        return self.apply_dropout(self.apply_activation(out), ctx)
+        out = self.apply_dropout(self.apply_activation(out), ctx)
+        t = self.conf.error_clipping_threshold
+        if t > 0:
+            out = like(out, _clip_error(value_of(out), t))
+        return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _clip_error(x, t):
+    """Identity whose backward clips the output-gradient to ±t — the
+    reference's per-layer error clipping (``Layer.cpp``
+    backwardActivation, ``ExtraLayerAttribute.error_clipping_threshold``)."""
+    return x
+
+
+def _clip_error_fwd(x, t):
+    return x, None
+
+
+def _clip_error_bwd(t, _res, dy):
+    return (jnp.clip(dy, -t, t),)
+
+
+_clip_error.defvjp(_clip_error_fwd, _clip_error_bwd)
 
 
 def cast_layer_output(layer: "Layer", out: Any) -> Any:

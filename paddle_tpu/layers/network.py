@@ -109,7 +109,8 @@ class NeuralNetwork:
             if pconf is not None \
                     and pconf.type in ("fc", "mkldnn_fc") \
                     and pconf.active_type == "softmax" \
-                    and pconf.drop_rate == 0:
+                    and pconf.drop_rate == 0 \
+                    and pconf.error_clipping_threshold == 0:
                 self._cost_logit_alias[cname] = pname + ".logits"
 
     def _collect_specs(self, layers, declared) -> None:

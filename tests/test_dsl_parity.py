@@ -540,3 +540,73 @@ def test_bidirectional_gru_rejects_unprefixed_kwargs():
         s = dsl.data_layer("s", dense_vector_sequence(6))
         with pytest.raises(Exception, match="fwd_/bwd_"):
             networks.bidirectional_gru(s, size=4, gru_bias_attr=False)
+
+
+def test_recurrent_units_lstm_group_matches_manual_loop():
+    """LstmRecurrentLayerGroup (recurrent_units.py:159) — the raw
+    config-parser-level helper family."""
+    from paddle_tpu.config import recurrent_units as ru
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.data.feeder import dense_vector_sequence
+
+    with config_scope():
+        s = dsl.data_layer("s", dense_vector_sequence(5))
+        out = ru.LstmRecurrentLayerGroup(
+            "lg", size=2, active_type="tanh", state_active_type="tanh",
+            gate_active_type="sigmoid",
+            inputs=[dsl.full_matrix_projection(s, size=8)])
+        cfg = dsl.topology([out])
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    # reference-convention parameter names from para_prefix
+    assert "lg_input_recurrent.w" in params
+    assert "lg_input_recurrent.b" in params
+    rng = np.random.RandomState(9)
+    raw = [rng.randn(3, 5).astype(np.float32)]
+    values, _ = net.forward(params, {"s": pad_batch(raw)})
+    h_seq = np.asarray(values["lg"].data)
+
+    w_in = np.asarray(params["_lg_transform_input.w0"])
+    w_h = np.asarray(params["lg_input_recurrent.w"])
+    b = np.asarray(params["lg_input_recurrent.b"])
+    # lstm_step's 3H bias holds the peephole checks (LstmStepLayer.cpp)
+    checks = np.asarray(params["lg_check.b"])
+    ck_i, ck_f, ck_o = checks[0:2], checks[2:4], checks[4:6]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    h = np.zeros(2, np.float32)
+    c = np.zeros(2, np.float32)
+    for t in range(3):
+        g = raw[0][t] @ w_in + h @ w_h + b
+        gi, gf, gc, go = g[0:2], g[2:4], g[4:6], g[6:8]
+        c_new = sig(gf + ck_f * c) * c + sig(gi + ck_i * c) * np.tanh(gc)
+        h = sig(go + ck_o * c_new) * np.tanh(c_new)
+        c = c_new
+        np.testing.assert_allclose(h_seq[0, t], h, atol=2e-5)
+
+
+def test_recurrent_units_gru_group_runs_and_shares_params():
+    from paddle_tpu.config import recurrent_units as ru
+    from paddle_tpu.core.sequence import pad_batch
+    from paddle_tpu.data.feeder import dense_vector_sequence
+
+    with config_scope():
+        s = dsl.data_layer("s", dense_vector_sequence(4))
+        a = ru.GatedRecurrentLayerGroup(
+            "g1", size=3, active_type="tanh", gate_active_type="sigmoid",
+            inputs=[dsl.full_matrix_projection(s, size=9)],
+            para_prefix="shared")
+        b = ru.GatedRecurrentLayerGroup(
+            "g2", size=3, active_type="tanh", gate_active_type="sigmoid",
+            inputs=[dsl.full_matrix_projection(s, size=9)],
+            para_prefix="shared")
+        cfg = dsl.topology([a, b])
+    net = NeuralNetwork(cfg)
+    params = net.init_params()
+    # same para_prefix → ONE shared recurrent weight + bias
+    assert "shared_gate.w" in params and "shared_gate.b" in params
+    assert sum(1 for k in params if k.endswith("_gate.w")) == 1
+    rng = np.random.RandomState(4)
+    sb = pad_batch([rng.randn(4, 4).astype(np.float32)])
+    values, _ = net.forward(params, {"s": sb})
+    g1 = np.asarray(values["g1"].data)
+    assert g1.shape[0] == 1 and g1.shape[1] >= 4 and g1.shape[2] == 3

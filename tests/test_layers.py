@@ -385,3 +385,43 @@ def test_recurrent_group_epilogue_hoist_equivalence(rng):
         np.testing.assert_allclose(np.asarray(g_opt[k]),
                                    np.asarray(g_ref[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_error_clipping_threshold_clips_backward(rng):
+    """ExtraLayerAttribute.error_clipping_threshold clips the layer's
+    output-gradient in backward (Layer.cpp backwardActivation)."""
+    import jax
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+
+    def build(thresh):
+        with config_scope():
+            x = dsl.data_layer("x", size=3)
+            out = dsl.fc_layer(
+                x, size=2, bias_attr=False, act=dsl.LinearActivation(),
+                name="out",
+                layer_attr=dsl.ExtraAttr(error_clipping_threshold=thresh)
+                if thresh else None)
+            cfg = dsl.topology([out])
+        return NeuralNetwork(cfg)
+
+    x = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+    cot = jnp.asarray([[5.0, -7.0], [0.2, 3.0]], np.float32)
+
+    def grad_in(net, params):
+        def loss(xi):
+            values, _ = net.forward(params, {"x": xi})
+            return jnp.sum(values["out"] * cot)
+        return np.asarray(jax.grad(loss)(x))
+
+    net0 = build(0.0)
+    params = net0.init_params()
+    w = np.asarray(params["_out.w0"])
+    g_free = grad_in(net0, params)
+    np.testing.assert_allclose(g_free, np.asarray(cot) @ w.T, rtol=1e-5)
+
+    net1 = build(1.0)
+    g_clip = grad_in(net1, params)
+    np.testing.assert_allclose(
+        g_clip, np.clip(np.asarray(cot), -1, 1) @ w.T, rtol=1e-5)

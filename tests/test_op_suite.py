@@ -363,16 +363,20 @@ def test_rank_losses():
                ["Left", "Right"])
     lab2 = np.where(lab > 0, 1.0, -1.0).astype(np.float32)
     m = 0.1
+    # push every hinge argument away from the kink so FD is valid and
+    # the gradient check always runs
+    hinge = -lab2 * (l - r) + m
+    shift = np.where(np.abs(hinge) < 0.15,
+                     (0.3 - hinge) * (-lab2), 0.0).astype(np.float32)
+    l = l + shift
     want2 = np.maximum(0, -lab2 * (l - r) + m)
-    # avoid the hinge kink for FD
-    mask = np.abs(-lab2 * (l - r) + m) < 0.1
-    if not mask.any():
-        check_grad("margin_rank_loss",
-                   {"X1": [l], "X2": [r], "Label": [lab2]},
-                   ["X1", "X2"], {"margin": m})
+    assert (np.abs(-lab2 * (l - r) + m) > 0.1).all()
     check_output("margin_rank_loss",
                  {"X1": [l], "X2": [r], "Label": [lab2]}, want2,
                  {"margin": m}, rtol=1e-4)
+    check_grad("margin_rank_loss",
+               {"X1": [l], "X2": [r], "Label": [lab2]},
+               ["X1", "X2"], {"margin": m})
 
 
 def test_dropout_test_mode_and_metrics():
