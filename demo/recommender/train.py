@@ -1,0 +1,120 @@
+"""MovieLens recommender — personalized rating prediction.
+
+Reference: ``python/paddle/v2/framework/tests/test_recommender_system.py``
+(the classic dual-tower model): user features (id/gender/age/job
+embeddings) and movie features (id embedding, category-bag embedding,
+title text-conv) each combine into a tower; rating = 5·cos(usr, mov),
+trained with square error against the MovieLens-1M ratings
+(``paddle_tpu.v2.dataset.movielens``, synthetic surrogate offline).
+
+Run: python demo/recommender/train.py [--passes N]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.data import datasets
+from paddle_tpu.trainer import events as ev
+
+
+def build_towers(meta, emb: int = 32, hidden: int = 64):
+    uid = paddle.layer.data(
+        "user_id", paddle.data_type.integer_value(meta["max_uid"] + 1))
+    gender = paddle.layer.data("gender", paddle.data_type.integer_value(2))
+    age = paddle.layer.data(
+        "age", paddle.data_type.integer_value(len(datasets.AGE_TABLE)))
+    job = paddle.layer.data(
+        "job", paddle.data_type.integer_value(meta["max_job"] + 1))
+    usr = paddle.layer.concat([
+        paddle.layer.fc(paddle.layer.embedding(uid, size=emb), size=emb),
+        paddle.layer.fc(paddle.layer.embedding(gender, size=8), size=8),
+        paddle.layer.fc(paddle.layer.embedding(age, size=8), size=8),
+        paddle.layer.fc(paddle.layer.embedding(job, size=8), size=8),
+    ])
+    usr = paddle.layer.fc(usr, size=hidden,
+                          act=paddle.activation.Tanh())
+
+    mid = paddle.layer.data(
+        "movie_id", paddle.data_type.integer_value(meta["max_mid"] + 1))
+    cats = paddle.layer.data(
+        "categories",
+        paddle.data_type.integer_value_sequence(meta["n_cats"]))
+    title = paddle.layer.data(
+        "title", paddle.data_type.integer_value_sequence(meta["n_title"]))
+    cat_bag = paddle.layer.pooling(
+        paddle.layer.embedding(cats, size=emb), paddle.pooling.Sum())
+    title_conv = paddle.networks.sequence_conv_pool(
+        paddle.layer.embedding(title, size=emb),
+        context_len=3, hidden_size=emb)
+    mov = paddle.layer.concat([
+        paddle.layer.fc(paddle.layer.embedding(mid, size=emb), size=emb),
+        cat_bag, title_conv])
+    mov = paddle.layer.fc(mov, size=hidden,
+                          act=paddle.activation.Tanh())
+    return usr, mov
+
+
+FEEDING = {"user_id": 0, "gender": 1, "age": 2, "job": 3,
+           "movie_id": 4, "categories": 5, "title": 6, "score": 7}
+
+
+def movielens_meta():
+    return {
+        "max_uid": datasets.movielens_max_user_id(),
+        "max_mid": datasets.movielens_max_movie_id(),
+        "max_job": datasets.movielens_max_job_id(),
+        "n_cats": len(datasets.movielens_movie_categories()),
+        "n_title": len(datasets.movielens_get_movie_title_dict()),
+    }
+
+
+def to_sample(rec):
+    uid, gender, age, job, mid, cats, title, rate = rec
+    return (uid, gender, age, job, mid,
+            np.asarray(cats or [0], np.int64),
+            np.asarray(title or [0], np.int64),
+            np.asarray(rate, np.float32))
+
+
+def build_model(meta, emb: int = 32, hidden: int = 64):
+    """(cost, score) — must run under a config scope."""
+    usr, mov = build_towers(meta, emb=emb, hidden=hidden)
+    score = paddle.layer.cos_sim(usr, mov, scale=5.0)
+    rating = paddle.layer.data("score", paddle.data_type.dense_vector(1))
+    return paddle.layer.square_error_cost(score, rating), score
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    with config_scope():
+        cost, _score = build_model(movielens_meta())
+        trainer = paddle.trainer.SGD(
+            cost,
+            update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+
+        reader = paddle.batch(
+            paddle.reader.map_readers(to_sample,
+                                      paddle.dataset.movielens.train()),
+            args.batch)
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: cost={event.metrics['cost']:.4f}")
+
+        trainer.train(reader, num_passes=args.passes,
+                      event_handler=handler, feeding=FEEDING)
+
+
+if __name__ == "__main__":
+    main()

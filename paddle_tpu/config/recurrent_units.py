@@ -54,11 +54,16 @@ def LstmRecurrentUnit(name: str, size: int, active_type: str,
                             initial_std=0),
         layer_attr=dsl.ExtraAttr(
             error_clipping_threshold=error_clipping_threshold))
-    return dsl.lstm_step_layer(
+    out = dsl.lstm_step_layer(
         gates, state_memory.out, size=size, name=name,
         act=active_type, gate_act=gate_active_type,
         state_act=state_active_type,
         bias_attr=ParamAttr(name=para_prefix + "_check.b"))
+    # the reference exposes the cell state as a named layer
+    # (GetOutputLayer '{name}_state', recurrent_units.py:72) so configs
+    # can consume it by name
+    dsl.get_output_layer(out, "state", name=f"{name}_state")
+    return out
 
 
 # the reference's Naive variant exists only to avoid the fused CUDA

@@ -112,13 +112,19 @@ class Layer:
             return like(out, data)
         return out
 
-    def finalize(self, out: Any, ctx: ForwardContext) -> Any:
-        """Activation then dropout, matching Layer::forwardActivation order."""
-        out = self.apply_dropout(self.apply_activation(out), ctx)
+    def apply_extras(self, out: Any, ctx: ForwardContext) -> Any:
+        """Dropout + backward error clip WITHOUT the activation — for
+        layers whose activation happens inside their own kernel
+        (lstm_step/gru_step gates)."""
+        out = self.apply_dropout(out, ctx)
         t = self.conf.error_clipping_threshold
         if t > 0:
             out = like(out, _clip_error(value_of(out), t))
         return out
+
+    def finalize(self, out: Any, ctx: ForwardContext) -> Any:
+        """Activation then dropout, matching Layer::forwardActivation order."""
+        return self.apply_extras(self.apply_activation(out), ctx)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

@@ -142,7 +142,8 @@ class LstmStepLayer(Layer):
             cell_act=self.conf.active_type or "tanh",
             out_act=self.conf.attrs.get("active_state_type", "tanh"))
         # expose (h, c); network stores dict outputs by name suffix
-        return {"out": like(inputs[0], out), "state": like(inputs[0], state.c)}
+        return {"out": self.apply_extras(like(inputs[0], out), ctx),
+                "state": like(inputs[0], state.c)}
 
 
 @register_layer("gru_step")
@@ -164,7 +165,7 @@ class GruStepLayer(Layer):
             x, h_prev, params[self.weight_name(0)],
             gate_act=self.conf.attrs.get("active_gate_type", "sigmoid"),
             act=self.conf.active_type or "tanh")
-        return like(inputs[0], out)
+        return self.apply_extras(like(inputs[0], out), ctx)
 
 
 @register_layer("mdlstmemory")

@@ -262,3 +262,41 @@ def test_v2_master_client_tcp():
     assert payload in ("t0", "t1")
     c.task_finished(tid)
     c.close()
+
+
+def test_recommender_system_trains():
+    """Dual-tower MovieLens recommender (test_recommender_system.py):
+    cos-sim rating regression over id/bag/text-conv features.  Reuses
+    the demo's model/sample/feeding definitions so test and demo can't
+    drift."""
+    import importlib.util
+    import os
+
+    from paddle_tpu.utils import FLAGS
+
+    demo_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "..", "demo", "recommender", "train.py")
+    spec = importlib.util.spec_from_file_location(
+        "recommender_demo_train", demo_path)
+    train_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train_mod)
+
+    with config_scope():
+        cost, _score = train_mod.build_model(train_mod.movielens_meta(),
+                                             emb=8, hidden=16)
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+
+        FLAGS.set("save_dir", "")
+        reader = paddle.batch(
+            paddle.reader.map_readers(
+                train_mod.to_sample, paddle.dataset.movielens.train()), 32)
+        costs = []
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                costs.append(event.metrics["cost"])
+
+        trainer.train(reader, num_passes=3, event_handler=handler,
+                      feeding=train_mod.FEEDING)
+        assert costs[-1] < costs[0], costs
