@@ -45,9 +45,22 @@ def install() -> None:
     """Idempotently register ``paddle``, ``paddle.trainer_config_helpers``,
     ``paddle.trainer.PyDataProvider2`` aliases + py2 shims."""
     global _installed
-    if _installed or "paddle" in sys.modules:
+    if _installed:
+        _install_py2_shims()
+        return
+    if "paddle" in sys.modules:
+        # a foreign 'paddle' (e.g. a real PaddlePaddle install) is
+        # already imported: don't shadow it, don't latch — a later
+        # call can still install if it gets removed
+        if not getattr(sys.modules["paddle"], "_paddle_tpu_compat", False):
+            import warnings
+            warnings.warn(
+                "paddle_tpu.compat.install(): a 'paddle' module is "
+                "already imported; not overriding it with the "
+                "paddle_tpu aliases")
+            _install_py2_shims()
+            return
         _installed = True
-        # py2 shims still needed even if a paddle module exists
         _install_py2_shims()
         return
 
@@ -59,6 +72,7 @@ def install() -> None:
 
     helpers = config_namespace()
     paddle = _mk_module("paddle", {})
+    paddle._paddle_tpu_compat = True
     trainer = _mk_module("paddle.trainer", {})
     _mk_module("paddle.trainer_config_helpers", helpers)
 
@@ -83,6 +97,26 @@ def install() -> None:
         "paddle.trainer_config_helpers"]
     trainer.PyDataProvider2 = sys.modules["paddle.trainer.PyDataProvider2"]
     trainer.config_parser = sys.modules["paddle.trainer.config_parser"]
+
+    # v2 user scripts: ``import paddle.v2 as paddle`` runs against the
+    # real paddle_tpu.v2 package (plus per-submodule aliases so
+    # ``from paddle.v2.X import ...`` resolves)
+    import paddle_tpu.v2 as v2mod
+
+    sys.modules["paddle.v2"] = v2mod
+    paddle.v2 = v2mod
+    # alias every paddle_tpu.v2 submodule (derived, so new submodules
+    # are picked up automatically)
+    for sub, m in vars(v2mod).items():
+        if isinstance(m, types.ModuleType) \
+                and m.__name__.startswith("paddle_tpu.v2."):
+            sys.modules[f"paddle.v2.{sub}"] = m
+    # third dotted level: dataset corpora are classes on the dataset
+    # module; register them so ``import paddle.v2.dataset.mnist``
+    # resolves (the import system honors existing sys.modules entries)
+    for cname, cobj in vars(v2mod.dataset).items():
+        if isinstance(cobj, type) and not cname.startswith("_"):
+            sys.modules[f"paddle.v2.dataset.{cname}"] = cobj
 
     _install_py2_shims()
     _installed = True

@@ -300,3 +300,20 @@ def test_recommender_system_trains():
         trainer.train(reader, num_passes=3, event_handler=handler,
                       feeding=train_mod.FEEDING)
         assert costs[-1] < costs[0], costs
+
+
+def test_compat_paddle_v2_alias():
+    """Reference v2 scripts (`import paddle.v2 as paddle`) run against
+    paddle_tpu.v2 through the compat alias."""
+    from paddle_tpu.compat import install
+
+    install()
+    import paddle.v2 as ref_paddle
+    import paddle.v2.dataset.mnist  # the era's deep-import form
+    from paddle.v2.dataset import mnist
+    from paddle.v2.networks import simple_gru
+
+    assert ref_paddle.layer is paddle.layer
+    assert callable(simple_gru)
+    assert callable(mnist.train)
+    assert callable(ref_paddle.batch)
