@@ -308,8 +308,12 @@ def test_compat_paddle_v2_alias():
     from paddle_tpu.compat import install
 
     install()
+    import importlib
+
     import paddle.v2 as ref_paddle
-    import paddle.v2.dataset.mnist  # the era's deep-import form
+    # the era's deep-import form (importlib avoids shadowing this test
+    # file's own `paddle` global with the alias root)
+    importlib.import_module("paddle.v2.dataset.mnist")
     from paddle.v2.dataset import mnist
     from paddle.v2.networks import simple_gru
 
