@@ -19,6 +19,7 @@ from .sharding import (ShardingRules, tp_rules, shard_params,
                        constraint)  # noqa: F401
 from .ring_attention import (ring_attention, ulysses_attention,
                              full_attention)  # noqa: F401
+from ..ops.pallas_attention import flash_attention  # noqa: F401
 from .sparse import (SelectedRows, unique_rows, row_gather,
                      row_scatter_add, row_scatter_set, touched_row_mask,
                      prefetch_rows, sparse_embedding_lookup)  # noqa: F401

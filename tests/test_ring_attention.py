@@ -67,3 +67,55 @@ def test_ring_long_sequence_memory_shape(mesh, rng):
     out = ring_attention(q, k, v, mesh, causal=True)
     assert out.shape == (1, 1024, 2, 8)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_full(causal, rng):
+    """Pallas flash attention (interpret mode on CPU) ≡ dense attention,
+    forward and gradients."""
+    from paddle_tpu.parallel import flash_attention
+
+    # bq == T (per-Mosaic-rule 'equal to array dim'), bk %8 — this exact
+    # config also lowers on real TPU hardware
+    B, T, H, D = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal, 64, 16)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+    cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    g_flash = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, causal, 64, 16) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda *a: jnp.sum(full_attention(*a, causal=causal) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_attention_untileable_shape_falls_back(rng):
+    """Block sizes that violate Mosaic tiling (bq=16: not %128, != T)
+    must dispatch to the dense fallback and stay exact, fwd + grad."""
+    from paddle_tpu.ops import pallas_attention as pa
+    from paddle_tpu.parallel import flash_attention
+
+    B, T, H, D = 1, 48, 2, 16
+    assert not pa._tiling_ok(T, 16, 12)   # the gate must reject this
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+               for _ in range(3))
+    out = flash_attention(q, k, v, True, 16, 12)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, True, 16, 12)
+                                     * cot), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(full_attention(*a, causal=True)
+                                     * cot), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
