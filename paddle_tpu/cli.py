@@ -164,6 +164,37 @@ def cmd_diagram(args) -> int:
     return 0
 
 
+def cmd_master(args) -> int:
+    """Standalone data-task master (the reference's standalone
+    coordinator binaries: ``paddle pserver`` / ``go/cmd/master``) — serve
+    the C++ task-lease service over TCP for remote trainers."""
+    import signal
+
+    from .data import recordio as rio
+    from .distributed import Master
+
+    m = Master(timeout_s=args.task_timeout, failure_max=args.failure_max,
+               snapshot_path=args.snapshot or None)
+    if args.dataset:
+        payloads = rio.expand_paths(args.dataset)
+        if args.chunked:
+            # same payload format cloud_reader's load_chunk parses
+            payloads = [f"{p}\t{off}" for p in payloads
+                        for off, _n in rio.load_index(p)]
+        m.set_dataset(payloads)
+        print(f"dataset: {len(payloads)} task(s)")
+    port = m.serve(args.port)
+    print(f"master serving on :{port}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    import time
+
+    while not stop:
+        time.sleep(0.5)
+    return 0
+
+
 def cmd_version(_args) -> int:
     import jax
 
@@ -221,6 +252,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     gp.add_argument("config")
     gp.add_argument("config_args", nargs="?", default="")
     gp.set_defaults(fn=cmd_diagram)
+
+    sp = sub.add_parser(
+        "master",
+        help="serve the standalone data-task master (pserver-era "
+             "coordinator)")
+    sp.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral, printed on start)")
+    sp.add_argument("--dataset", nargs="*", default=[],
+                    help="task payloads: file paths / globs")
+    sp.add_argument("--chunked", action="store_true",
+                    help="expand recordio files into per-chunk tasks")
+    sp.add_argument("--task_timeout", type=float, default=60.0)
+    sp.add_argument("--failure_max", type=int, default=3)
+    sp.add_argument("--snapshot", default="",
+                    help="snapshot/recover state file")
+    sp.set_defaults(fn=cmd_master)
 
     vp = sub.add_parser("version", help="print build info")
     vp.set_defaults(fn=cmd_version)

@@ -111,3 +111,55 @@ def test_two_process_global_mesh():
                            if l.startswith("TRAIN_LOSS")][0])
     # the loss is a global all-reduced scalar: identical on both hosts
     assert loss_lines[0] == loss_lines[1], loss_lines
+
+
+def test_cli_master_subcommand(tmp_path):
+    """`paddle master --dataset ... --chunked` serves chunk tasks over
+    TCP (the standalone coordinator binary of the reference era)."""
+    import pickle
+    import re
+    import subprocess
+    import sys
+    import time
+
+    from paddle_tpu.data import recordio as rio
+    from paddle_tpu.distributed import MasterClient
+
+    path = str(tmp_path / "part-00000")
+    with rio.Writer(path, max_records_per_chunk=2) as w:
+        for i in range(5):
+            w.write(pickle.dumps(i))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "master",
+         "--dataset", path, "--chunked"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        captured = []
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line and proc.poll() is not None:
+                break                     # child died before serving
+            captured.append(line)
+            m = re.search(r"serving on :(\d+)", line or "")
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, f"master did not start; output: {''.join(captured)}"
+        c = MasterClient(f"127.0.0.1:{port}")
+        seen = []
+        while True:
+            tid, payload = c.get_task()
+            if payload is None:
+                break
+            p, off = payload.rsplit("\t", 1)
+            seen.extend(pickle.loads(r)
+                        for r in rio.read_chunk(p, int(off)))
+            c.task_finished(tid)
+        assert sorted(seen) == [0, 1, 2, 3, 4]
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
