@@ -213,7 +213,7 @@ class MasterService {
     std::rename((snapshot_path_ + ".tmp").c_str(), snapshot_path_.c_str());
   }
 
-  int Serve(int port);
+  int Serve(int port, bool bind_any = false);
   void StopServer();
   ~MasterService() { StopServer(); }
 
@@ -408,14 +408,16 @@ void MasterService::ServerLoop() {
   }
 }
 
-int MasterService::Serve(int port) {
+int MasterService::Serve(int port, bool bind_any) {
   server_fd_ = socket(AF_INET, SOCK_STREAM, 0);
   if (server_fd_ < 0) return -1;
   int opt = 1;
   setsockopt(server_fd_, SOL_SOCKET, SO_REUSEADDR, &opt, sizeof(opt));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  // loopback by default; standalone coordinators opt into all
+  // interfaces (the reference pservers/masters always bind any)
+  addr.sin_addr.s_addr = htonl(bind_any ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(server_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
     return -1;
@@ -501,8 +503,8 @@ void ptpu_master_snapshot(void* h) {
 }
 
 // start loopback TCP server; returns bound port (or -1)
-int ptpu_master_serve(void* h, int port) {
-  return static_cast<MasterService*>(h)->Serve(port);
+int ptpu_master_serve(void* h, int port, int bind_any) {
+  return static_cast<MasterService*>(h)->Serve(port, bind_any != 0);
 }
 
 }  // extern "C"

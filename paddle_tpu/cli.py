@@ -174,24 +174,27 @@ def cmd_master(args) -> int:
     from .distributed import Master
 
     m = Master(timeout_s=args.task_timeout, failure_max=args.failure_max,
-               snapshot_path=args.snapshot or None)
+               snapshot_path=args.snapshot or "")
     if args.dataset:
-        payloads = rio.expand_paths(args.dataset)
-        if args.chunked:
-            # same payload format cloud_reader's load_chunk parses
-            payloads = [f"{p}\t{off}" for p in payloads
-                        for off, _n in rio.load_index(p)]
+        payloads = rio.chunk_payloads(args.dataset) if args.chunked \
+            else rio.expand_paths(args.dataset)
         m.set_dataset(payloads)
         print(f"dataset: {len(payloads)} task(s)")
-    port = m.serve(args.port)
+    port = m.serve(args.port, bind_any=not args.local_only)
     print(f"master serving on :{port}", flush=True)
     stop = []
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     import time
 
+    last_snap = time.time()
     while not stop:
         time.sleep(0.5)
+        if args.snapshot and time.time() - last_snap >= args.snapshot_period:
+            m.snapshot()
+            last_snap = time.time()
+    if args.snapshot:
+        m.snapshot()
     return 0
 
 
@@ -266,7 +269,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--task_timeout", type=float, default=60.0)
     sp.add_argument("--failure_max", type=int, default=3)
     sp.add_argument("--snapshot", default="",
-                    help="snapshot/recover state file")
+                    help="snapshot/recover state file (written every "
+                         "--snapshot_period seconds and on shutdown)")
+    sp.add_argument("--snapshot_period", type=float, default=30.0)
+    sp.add_argument("--local_only", action="store_true",
+                    help="bind loopback instead of all interfaces")
     sp.set_defaults(fn=cmd_master)
 
     vp = sub.add_parser("version", help="print build info")

@@ -259,12 +259,7 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
 
     from ..distributed.master import master_reader
 
-    file_list = rio.expand_paths(paths)
-    payloads = []
-    for path in file_list:
-        for off, _n in rio.load_index(path):
-            payloads.append(f"{path}\t{off}")
-    master.set_dataset(payloads)
+    master.set_dataset(rio.chunk_payloads(paths))
 
     def load_chunk(payload):
         path, off = payload.rsplit("\t", 1)

@@ -150,3 +150,11 @@ def reader(paths: Union[str, Sequence[str]]) -> Iterator[bytes]:
     for path in expand_paths(paths):
         for off, _n in load_index(path):
             yield from read_chunk(path, off)
+
+
+def chunk_payloads(paths: Union[str, Sequence[str]]) -> List[str]:
+    """Master task payloads addressing individual chunks
+    (``"path\\toffset"`` — the format :func:`paddle_tpu.data.reader.
+    cloud_reader`'s ``load_chunk`` parses)."""
+    return [f"{p}\t{off}" for p in expand_paths(paths)
+            for off, _n in load_index(p)]

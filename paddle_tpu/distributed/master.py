@@ -56,7 +56,8 @@ def _load_lib() -> ctypes.CDLL:
         [ctypes.POINTER(ctypes.c_int)] * 4
     lib.ptpu_master_snapshot.argtypes = [ctypes.c_void_p]
     lib.ptpu_master_serve.restype = ctypes.c_int
-    lib.ptpu_master_serve.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_master_serve.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_int]
     _lib = lib
     return lib
 
@@ -110,9 +111,11 @@ class Master:
     def snapshot(self) -> None:
         self._lib.ptpu_master_snapshot(self._h)
 
-    def serve(self, port: int = 0) -> int:
-        """Start the loopback TCP server; returns the bound port."""
-        p = self._lib.ptpu_master_serve(self._h, port)
+    def serve(self, port: int = 0, bind_any: bool = False) -> int:
+        """Start the TCP server (loopback by default; ``bind_any``
+        listens on all interfaces for multi-host trainers); returns the
+        bound port."""
+        p = self._lib.ptpu_master_serve(self._h, port, int(bind_any))
         enforce(p > 0, "master serve failed")
         return p
 

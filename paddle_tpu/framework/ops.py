@@ -677,7 +677,8 @@ def _lstm(ctx, ins, attrs):
 
 @register_op("lstm_unit")
 def _lstm_unit(ctx, ins, attrs):
-    h, c = recurrent_ops.lstm_unit(value_of(_in(ins, "X")),
+    # recurrent_ops.lstm_unit returns (c, h) — C first
+    c, h = recurrent_ops.lstm_unit(value_of(_in(ins, "X")),
                                    value_of(_in(ins, "C_prev")),
                                    attrs.get("forget_bias", 0.0))
     return {"H": [h], "C": [c]}

@@ -135,15 +135,26 @@ def test_cli_master_subcommand(tmp_path):
          "--dataset", path, "--chunked"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
+        import queue
+        import threading
+
+        lines: "queue.Queue" = queue.Queue()
+        threading.Thread(
+            target=lambda: [lines.put(l) for l in proc.stdout] +
+                           [lines.put(None)],
+            daemon=True).start()
         port = None
         captured = []
         deadline = time.time() + 60
         while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line and proc.poll() is not None:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - time.time()))
+            except queue.Empty:
+                break
+            if line is None:
                 break                     # child died before serving
             captured.append(line)
-            m = re.search(r"serving on :(\d+)", line or "")
+            m = re.search(r"serving on :(\d+)", line)
             if m:
                 port = int(m.group(1))
                 break
