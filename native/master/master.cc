@@ -98,6 +98,13 @@ class MasterService {
   void SetDataset(const std::vector<std::string>& payloads) {
     std::lock_guard<std::mutex> g(mu_);
     if (recovered_) return;  // snapshot wins, like the etcd state
+    // Every trainer calls SetDataset; only the first non-empty call
+    // takes effect (the reference's initDone guard,
+    // go/master/service.go:287, which also rejects an empty dataset) so
+    // a trainer joining mid-pass can't wipe the shared queue and orphan
+    // live leases, and a stray empty SET can't brick the service.
+    if (initialized_ || payloads.empty()) return;
+    initialized_ = true;
     todo_.clear();
     pending_.clear();
     done_.clear();
@@ -109,13 +116,18 @@ class MasterService {
       t.payload = p;
       todo_.push_back(std::move(t));
     }
-
   }
 
   // 0 = task granted; 1 = wait (all leased); -1 = pass finished
   int GetTask(std::string* payload, int* task_id) {
     std::lock_guard<std::mutex> g(mu_);
     CheckTimeouts();
+    // A trainer that finished the pass early may have requested the
+    // next epoch while peers still held leases; honor it the moment the
+    // queue drains so that trainer's next GET starts the new epoch
+    // instead of seeing DONE (zero-sample pass).
+    if (todo_.empty() && pending_.empty() && epoch_ < reset_target_)
+      ResetLocked();
     if (!todo_.empty()) {
       Task t = std::move(todo_.front());
       todo_.pop_front();
@@ -150,23 +162,43 @@ class MasterService {
     return 0;
   }
 
-  // new epoch over the same shards (done+failed → todo); idempotent —
-  // a second trainer's reset while work is still queued is a no-op, so
-  // N trainers draining the same queue reset exactly once per epoch
-  void ResetEpoch() {
+  // new epoch over the same shards (done+failed → todo) — the
+  // reference's start_get_records(pass_num) handshake. target_epoch is
+  // the epoch number the caller wants to begin (a trainer that finished
+  // pass P requests P+1): if a peer already performed that reset
+  // (epoch_ >= target) the call is a pure no-op, so N trainers hitting
+  // the boundary back-to-back — in any interleaving, including with the
+  // refilled epoch fully leased — reset exactly once and never schedule
+  // a phantom extra pass. If work is still queued/leased the reset is
+  // *armed* (reset_target_) and GetTask performs it once the queue
+  // drains, so an early-finishing trainer still gets a full next pass.
+  // target_epoch < 0 is the legacy argless form: no-op while todo has
+  // work, otherwise behaves as epoch_+1.
+  void ResetEpoch(int target_epoch) {
     std::lock_guard<std::mutex> g(mu_);
     CheckTimeouts();
-    if (!todo_.empty() || !pending_.empty()) return;
-    for (auto& t : done_) {
-      t.failures = 0;
-      todo_.push_back(std::move(t));
+    if (target_epoch < 0) {
+      // legacy argless reset: act only when fully drained (the
+      // pre-handshake behavior). Without a pass number a late duplicate
+      // reset is indistinguishable from a needed one, so arming here
+      // would schedule a phantom extra pass; numbered clients get the
+      // full armed-reset semantics below.
+      if (todo_.empty() && pending_.empty()) ResetLocked();
+      return;
     }
-    done_.clear();
-    for (auto& t : failed_) {
-      t.failures = 0;
-      todo_.push_back(std::move(t));
-    }
-    failed_.clear();
+    if (target_epoch <= epoch_) return;  // peer already reset this round
+    if (!todo_.empty()) return;  // pass still has work — stale/early request
+    reset_target_ = epoch_ + 1;
+    if (pending_.empty()) ResetLocked();
+  }
+
+  // current epoch number — clients that (re)connect to a long-lived or
+  // recovered master read this to offset their local pass counters, so
+  // a restarted trainer's reset requests keep advancing instead of
+  // no-opping against a larger persisted epoch_.
+  int Epoch() {
+    std::lock_guard<std::mutex> g(mu_);
+    return epoch_;
   }
 
   // save-model election (one trainer wins per interval)
@@ -203,6 +235,7 @@ class MasterService {
       os << tag << "\t" << t.id << "\t" << t.failures << "\t"
          << EscapePayload(t.payload) << "\n";
     };
+    os << "epoch\t" << epoch_ << "\t0\t-\n";
     for (const auto& t : todo_) dump("todo", t);
     for (const auto& kv : pending_) dump("todo", kv.second);  // re-lease
     for (const auto& t : done_) dump("done", t);
@@ -230,6 +263,20 @@ class MasterService {
     }
   }
 
+  void ResetLocked() {  // caller holds mu_; todo_/pending_ empty
+    for (auto& t : done_) {
+      t.failures = 0;
+      todo_.push_back(std::move(t));
+    }
+    done_.clear();
+    for (auto& t : failed_) {
+      t.failures = 0;
+      todo_.push_back(std::move(t));
+    }
+    failed_.clear();
+    ++epoch_;
+  }
+
   void ProcessFailed(Task t) {  // caller holds mu_
     t.failures++;
     if (t.failures >= failure_max_) {
@@ -251,6 +298,10 @@ class MasterService {
       if (!(is >> tag >> id >> failures)) continue;
       std::getline(is, payload);
       if (!payload.empty() && payload[0] == '\t') payload.erase(0, 1);
+      if (tag == "epoch") {
+        epoch_ = id;
+        continue;
+      }
       Task t;
       t.id = id;
       t.failures = failures;
@@ -283,6 +334,9 @@ class MasterService {
   int next_id_ = 0;
 
   bool recovered_ = false;
+  bool initialized_ = false;  // first SetDataset wins (initDone guard)
+  int epoch_ = 0;             // completed epoch resets
+  int reset_target_ = 0;      // deferred epoch reset, see ResetEpoch()
   std::string save_owner_;
   Clock::time_point save_expiry_{};
 
@@ -299,7 +353,8 @@ class MasterService {
 // FIN\t<id>               -> OK | ERR
 // FAIL\t<id>              -> OK | ERR
 // SET\t<p1>\x1f<p2>...    -> OK
-// RESET                   -> OK
+// RESET[\t<epoch>]        -> OK    (epoch = pass-number handshake)
+// EPOCH                   -> <current epoch number>
 // SAVE\t<trainer>\t<sec>  -> 1 | 0
 // COUNTS                  -> <todo>\t<pending>\t<done>\t<failed>
 std::string MasterService::HandleLine(const std::string& line) {
@@ -341,7 +396,9 @@ std::string MasterService::HandleLineImpl(const std::string& line) {
     return "OK";
   }
   if (cmd == "RESET") {
-    ResetEpoch();
+    std::string epoch_s;
+    std::getline(is, epoch_s, '\t');
+    ResetEpoch(epoch_s.empty() ? -1 : std::stoi(epoch_s));
     return "OK";
   }
   if (cmd == "SAVE") {
@@ -349,6 +406,9 @@ std::string MasterService::HandleLineImpl(const std::string& line) {
     std::getline(is, trainer, '\t');
     std::getline(is, sec, '\t');
     return std::to_string(RequestSaveModel(trainer, std::stod(sec)));
+  }
+  if (cmd == "EPOCH") {
+    return std::to_string(Epoch());
   }
   if (cmd == "COUNTS") {
     int a, b, c, d;
@@ -483,8 +543,14 @@ int ptpu_master_task_failed(void* h, int task_id) {
   return static_cast<MasterService*>(h)->TaskFailed(task_id);
 }
 
-void ptpu_master_reset_epoch(void* h) {
-  static_cast<MasterService*>(h)->ResetEpoch();
+// target_epoch: the epoch the caller wants to begin (pass-number
+// handshake); -1 = legacy argless reset
+void ptpu_master_reset_epoch(void* h, int target_epoch) {
+  static_cast<MasterService*>(h)->ResetEpoch(target_epoch);
+}
+
+int ptpu_master_epoch(void* h) {
+  return static_cast<MasterService*>(h)->Epoch();
 }
 
 int ptpu_master_request_save_model(void* h, const char* trainer_id,

@@ -371,6 +371,11 @@ def _proj_out_size(pc: ProjConfig) -> int:
 def concat(input: Input, act=None, name: Optional[str] = None,
            bias_attr=False, layer_attr=None) -> LayerOutput:
     ins = _as_list(input)
+    n_proj = sum(1 for i in ins if isinstance(i, tuple))
+    if n_proj not in (0, len(ins)):
+        raise ConfigError(
+            "concat_layer inputs must be all layers or all projections, "
+            f"got {n_proj} projection(s) among {len(ins)} inputs")
     if ins and isinstance(ins[0], tuple):
         # Projection inputs → 'concat2' (projection outputs concatenated;
         # reference layers.py:3309 CONCAT_PROJ_LAYER dispatch)

@@ -259,6 +259,9 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
 
     from ..distributed.master import master_reader
 
+    # every trainer calls set_dataset; the master honors only the FIRST
+    # call (initDone guard, go/master/service.go:287) so a trainer
+    # joining mid-pass cannot wipe the shared queue
     master.set_dataset(rio.chunk_payloads(paths))
 
     def load_chunk(payload):
@@ -267,16 +270,24 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
             yield pickle.loads(rec)
 
     inner = master_reader(master, load_chunk)
-    first_pass = [True]
+    # offset the local pass counter by the master's epoch so a trainer
+    # (re)joining a long-lived or snapshot-recovered master doesn't send
+    # reset requests the master has already performed
+    epoch_base = master.current_epoch()
+    pass_num = [0]
 
     def reader():
-        # the trainer re-invokes reader() once per pass; re-arm the task
-        # queue for passes 2..N (reset_epoch is a no-op while work is
-        # still queued, so N trainers re-arm exactly once — the
-        # reference's start_get_records(pass_num) handshake)
-        if not first_pass[0]:
-            master.reset_epoch()
-        first_pass[0] = False
+        # the trainer re-invokes reader() once per pass; request the
+        # next epoch for passes 2..N, carrying the pass number (the
+        # reference's start_get_records(pass_num) handshake). The master
+        # resets exactly once per epoch no matter how N trainers'
+        # requests interleave — duplicates for an already-performed
+        # reset are no-ops, and a request made while peers still hold
+        # leases is armed and performed when the queue drains, so an
+        # early-finishing trainer never sees a zero-sample next pass.
+        if pass_num[0]:
+            master.reset_epoch(epoch_base + pass_num[0])
+        pass_num[0] += 1
         yield from inner()
 
     return buffered(reader, buf_size)

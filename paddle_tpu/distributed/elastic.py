@@ -66,10 +66,14 @@ class ElasticTrainer:
     def train(self, feeder, batch_size: int, num_epochs: int = 1,
               event_handler: Optional[Callable] = None) -> None:
         self.resume()
+        # pass-number handshake, offset by the master's epoch so a
+        # restarted trainer's resets keep advancing against a recovered
+        # or long-lived master instead of no-opping (zero-sample passes)
+        epoch_base = self.client.current_epoch()
         for epoch in range(num_epochs):
             self._train_one_epoch(feeder, batch_size, epoch, event_handler)
             self._maybe_checkpoint(epoch, force=True)
-            self.client.reset_epoch()
+            self.client.reset_epoch(epoch_base + epoch + 1)
             log.info("epoch %d complete: %s", epoch, self.client.counts())
 
     def _train_one_epoch(self, feeder, batch_size: int, epoch: int,

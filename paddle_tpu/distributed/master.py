@@ -48,7 +48,9 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int)]
     lib.ptpu_master_task_finished.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ptpu_master_task_failed.argtypes = [ctypes.c_void_p, ctypes.c_int]
-    lib.ptpu_master_reset_epoch.argtypes = [ctypes.c_void_p]
+    lib.ptpu_master_reset_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptpu_master_epoch.restype = ctypes.c_int
+    lib.ptpu_master_epoch.argtypes = [ctypes.c_void_p]
     lib.ptpu_master_request_save_model.restype = ctypes.c_int
     lib.ptpu_master_request_save_model.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
@@ -93,8 +95,17 @@ class Master:
     def task_failed(self, task_id: int) -> None:
         self._lib.ptpu_master_task_failed(self._h, task_id)
 
-    def reset_epoch(self) -> None:
-        self._lib.ptpu_master_reset_epoch(self._h)
+    def reset_epoch(self, target_epoch: int = -1) -> None:
+        """Request the start of ``target_epoch`` (pass-number handshake:
+        a trainer that finished pass P asks for P+1); peers' duplicate
+        requests for an already-performed reset are no-ops. ``-1`` is
+        the legacy argless reset."""
+        self._lib.ptpu_master_reset_epoch(self._h, target_epoch)
+
+    def current_epoch(self) -> int:
+        """Epoch counter — read on (re)connect to offset local pass
+        counters against a long-lived or snapshot-recovered master."""
+        return self._lib.ptpu_master_epoch(self._h)
 
     def request_save_model(self, trainer_id: str,
                            interval_s: float = 60.0) -> bool:
@@ -195,8 +206,15 @@ class MasterClient:
     def task_failed(self, task_id: int) -> None:
         self._call(f"FAIL\t{task_id}")
 
-    def reset_epoch(self) -> None:
-        self._call("RESET")
+    def reset_epoch(self, target_epoch: int = -1) -> None:
+        self._call("RESET" if target_epoch < 0 else f"RESET\t{target_epoch}")
+
+    def current_epoch(self) -> int:
+        resp = self._call("EPOCH")
+        try:
+            return int(resp)
+        except ValueError:
+            return 0  # pre-EPOCH master binary: degrade to legacy base
 
     def request_save_model(self, trainer_id: str,
                            interval_s: float = 60.0) -> bool:

@@ -30,6 +30,106 @@ def test_master_lease_and_finish():
     assert c == {"todo": 3, "pending": 0, "done": 1, "failed": 0}
 
 
+def test_master_set_dataset_first_wins():
+    # every trainer calls set_dataset; only the first takes effect
+    # (go/master/service.go:287 initDone guard) — a late joiner must not
+    # wipe the shared queue and orphan live leases
+    m = Master(timeout_s=5, failure_max=3)
+    m.set_dataset(["a", "b"])
+    m.get_task()
+    m.set_dataset(["x", "y", "z"])
+    c = m.counts()
+    assert c == {"todo": 1, "pending": 1, "done": 0, "failed": 0}
+
+
+def test_master_early_reset_armed_until_drain():
+    # trainer A finishes the pass while B still holds a lease; A's reset
+    # must fire once the queue drains — not be dropped, which would give
+    # A a zero-sample next pass
+    m = Master(timeout_s=5, failure_max=3)
+    m.set_dataset(["a", "b"])
+    tid_a, _ = m.get_task()
+    tid_b, _ = m.get_task()
+    m.task_finished(tid_a)
+    m.reset_epoch(1)                 # armed: B still pending
+    rc, payload = m.get_task()
+    assert rc == 1 and payload is None   # WAIT, not DONE
+    m.task_finished(tid_b)
+    _, payload = m.get_task()        # drain → armed reset fires
+    assert payload in ("a", "b")
+    c = m.counts()
+    assert c["todo"] == 1 and c["pending"] == 1 and c["done"] == 0
+
+
+def test_master_epoch_boundary_double_reset_no_extra_pass():
+    # both trainers see DONE and call reset_epoch back-to-back (the path
+    # every real client takes); the second reset must be a pure no-op —
+    # arming a stale reset would suppress the next DONE and grant a
+    # phantom extra pass
+    m = Master(timeout_s=5, failure_max=3)
+    m.set_dataset(["a", "b"])
+    for _ in range(2):
+        tid, _ = m.get_task()
+        m.task_finished(tid)
+    rc, _ = m.get_task()
+    assert rc == -1                  # pass 1 DONE
+    m.reset_epoch(1)                 # trainer A refills epoch 2
+    m.reset_epoch(1)                 # trainer B: no-op, must not arm
+    tid_a, _ = m.get_task()
+    tid_b, _ = m.get_task()          # epoch 2 fully leased
+    m.reset_epoch(1)                 # trainer C, late: still a no-op
+    m.task_finished(tid_a)
+    m.task_finished(tid_b)
+    rc, payload = m.get_task()
+    assert rc == -1 and payload is None   # epoch 2 DONE — no pass 3
+
+
+def test_master_reset_noop_while_pass_has_work():
+    # a desynced/buggy client's reset mid-pass (todo still has work)
+    # must be a pure no-op — arming it would auto-fire at drain and
+    # blend two epochs into one pass with no DONE boundary
+    m = Master(timeout_s=5, failure_max=3)
+    m.set_dataset(["a", "b"])
+    tid, _ = m.get_task()
+    m.task_finished(tid)
+    m.reset_epoch(5)                 # mid-pass: "b" still in todo
+    tid, _ = m.get_task()
+    m.task_finished(tid)
+    rc, _ = m.get_task()
+    assert rc == -1                  # DONE is observed — no blend
+
+
+def test_master_epoch_counter_restart_sync(tmp_path):
+    # a restarted trainer reads the master's epoch and offsets its local
+    # pass counter, so its resets keep advancing after snapshot-recovery
+    snap = str(tmp_path / "snap")
+    m = Master(timeout_s=5, failure_max=3, snapshot_path=snap)
+    m.set_dataset(["a"])
+    tid, _ = m.get_task()
+    m.task_finished(tid)
+    m.reset_epoch(1)
+    assert m.current_epoch() == 1
+    m.snapshot()
+    del m
+    m2 = Master(timeout_s=5, failure_max=3, snapshot_path=snap)
+    assert m2.current_epoch() == 1   # persisted
+    tid, _ = m2.get_task()
+    m2.task_finished(tid)
+    m2.reset_epoch(m2.current_epoch() + 1)   # what a synced client sends
+    _, payload = m2.get_task()
+    assert payload == "a"            # advanced — not a permanent no-op
+
+
+def test_master_empty_set_dataset_does_not_brick():
+    # a stray empty SET (misconfigured early trainer) must not consume
+    # the first-call-wins slot; the next real dataset still registers
+    m = Master(timeout_s=5, failure_max=3)
+    m.set_dataset([])
+    m.set_dataset(["a"])
+    _, payload = m.get_task()
+    assert payload == "a"
+
+
 def test_master_lease_timeout_requeues():
     m = Master(timeout_s=0.2, failure_max=3)
     m.set_dataset(["a", "b"])

@@ -91,6 +91,21 @@ def test_new_wrappers_build_image_glue():
     assert "__pad_" in " ".join(net.layers)
 
 
+def test_concat_rejects_mixed_projection_and_layer_inputs():
+    # all-or-nothing input kinds (reference concat_layer asserts over
+    # input kinds); a mixed list must raise ConfigError, not crash on
+    # t[1]/i.size or silently mis-handle trailing projections
+    from paddle_tpu.utils import ConfigError
+    from paddle_tpu.data.feeder import dense_vector
+    with config_scope():
+        a = dsl.data_layer("a", dense_vector(6))
+        b = dsl.data_layer("b", dense_vector(6))
+        with pytest.raises(ConfigError):
+            dsl.concat_layer([a, dsl.full_matrix_projection(b, size=4)])
+        with pytest.raises(ConfigError):
+            dsl.concat_layer([dsl.full_matrix_projection(b, size=4), a])
+
+
 def test_new_wrappers_build_dense_misc():
     def topo():
         from paddle_tpu.data.feeder import dense_vector
