@@ -1,6 +1,6 @@
 """Headline benchmarks, matched to BASELINE.json's primary metrics.
 
-Three workloads (the first printed line is the driver-parsed metric):
+Four workloads (the first printed line is the driver-parsed metric):
 
 1. **LSTM text classifier** training ms/batch — the reference RNN
    benchmark (``benchmark/paddle/rnn/rnn.py`` via ``paddle train
@@ -16,6 +16,9 @@ Three workloads (the first printed line is the driver-parsed metric):
    number ("will be added later", ``benchmark/README.md:141``), so
    vs_baseline keys off the same P40-class yardstick via the reference
    4-GPU LSTM row scaled to tokens (documented below).
+4. **transformer** training tokens/sec at T=2048 — the flash-attention
+   kernel's product surface (``scaled_dot_product_attention`` layer);
+   no reference yardstick exists (2017 codebase), MFU is the figure.
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
 Adam).  Timing chains K steps inside one ``lax.scan`` program (see
@@ -302,13 +305,57 @@ def bench_seq2seq():
     }
 
 
+def bench_attention():
+    """Transformer encoder training tokens/sec at long context (T=2048)
+    — the product surface of the Pallas flash-attention kernel
+    (``ops/pallas_attention.py`` via the ``scaled_dot_product_attention``
+    layer).  The reference predates transformers, so like seq2seq there
+    is no published yardstick; MFU is the comparable figure."""
+    FLAGS.set("bf16_activations", True)
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer_text_classifier
+
+    B, T, D, HEADS, L, F, V = 8, 2048, 512, 8, 4, 2048, 30000
+    cfg = transformer_text_classifier(
+        vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
+        ffn_dim=F, num_classes=2, max_len=T)
+    trainer = _mk_trainer(cfg, lr=1e-3)
+
+    rng = np.random.RandomState(0)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
+                jax.numpy.asarray(np.full((B,), T, np.int32))),
+            "label": jax.numpy.asarray(rng.randint(0, 2, (B,)).astype(np.int32))}
+
+    ms, agree = _scan_time_ms(trainer, feed, iters=32)
+    n = _n_chips(trainer)
+    tokens_per_sec = B * T / (ms / 1e3)
+    # fwd MACs/layer: qkv B·T·D·3D + scores B·T²·D + p·v B·T²·D +
+    # out-proj B·T·D·D + ffn B·T·2·D·F; embedding/head negligible
+    fwd = 2 * L * B * T * (3 * D * D + 2 * T * D + D * D + 2 * D * F)
+    mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
+    return {
+        "metric": "transformer_tokens_per_sec",
+        "value": round(tokens_per_sec, 0),
+        "unit": f"tokens/sec (bs={B}, T={T}, d={D}, {L}L/{HEADS}H, "
+                "flash attention)",
+        "vs_baseline_note": "reference predates transformers; no "
+                            "published number",
+        "mfu_est": round(mfu, 3),
+        "devices": n,
+        "timing_self_check": round(agree, 3),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=["lstm", "resnet", "seq2seq"])
+    ap.add_argument("--only",
+                    choices=["lstm", "resnet", "seq2seq", "attention"])
     args = ap.parse_args()
     benches = {"lstm": bench_lstm, "resnet": bench_resnet,
-               "seq2seq": bench_seq2seq}
-    order = [args.only] if args.only else ["lstm", "resnet", "seq2seq"]
+               "seq2seq": bench_seq2seq, "attention": bench_attention}
+    order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
+                                           "attention"]
     for name in order:
         try:
             print(json.dumps(benches[name]()), flush=True)

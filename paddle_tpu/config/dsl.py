@@ -394,6 +394,66 @@ def concat(input: Input, act=None, name: Optional[str] = None,
 concat_layer = concat
 
 
+def scaled_dot_product_attention(input: Input, size: int,
+                                 num_heads: int = 1, causal: bool = False,
+                                 name: Optional[str] = None, act=None,
+                                 bias_attr=False,
+                                 param_attr: Optional[ParamAttr] = None,
+                                 layer_attr=None, block_q: int = 512,
+                                 block_k: int = 512) -> LayerOutput:
+    """Multi-head attention backed by the Pallas flash-attention kernel
+    (``ops/pallas_attention.py``) — the kernel→layer→config wiring the
+    reference used for ``hl_lstm``→``LstmLayer``→``lstmemory``.
+
+    One input = self-attention; a ``[query, key, value]`` list =
+    cross-attention.  Padded keys are masked from the sequence lengths.
+    """
+    ins = _as_list(input)
+    if len(ins) not in (1, 3):
+        raise ConfigError(
+            "scaled_dot_product_attention takes 1 input (self-attention) "
+            f"or 3 (query, key, value), got {len(ins)}")
+    pas = [param_attr] + [None] * (len(ins) - 1) if param_attr else None
+    return _add_layer(name, "scaled_dot_product_attention", size,
+                      _mk_inputs(ins, pas), act, bias_attr,
+                      attrs={"num_heads": num_heads, "causal": causal,
+                             "block_q": block_q, "block_k": block_k},
+                      layer_attr=layer_attr, param_attrs=pas)
+
+
+multi_head_attention = scaled_dot_product_attention
+scaled_dot_product_attention_layer = scaled_dot_product_attention
+
+
+def layer_norm(input: Input, name: Optional[str] = None, act=None,
+               bias_attr=True, epsilon: float = 1e-5,
+               layer_attr=None) -> LayerOutput:
+    """Layer normalization over the feature dim with learned gain/bias."""
+    inp = _as_list(input)[0]
+    return _add_layer(name, "layer_norm", inp.size, _mk_inputs([inp]),
+                      act, bias_attr, attrs={"epsilon": epsilon},
+                      layer_attr=layer_attr)
+
+
+layer_norm_layer = layer_norm
+
+
+def position_embedding(input: Input, max_len: int,
+                       name: Optional[str] = None,
+                       param_attr: Optional[ParamAttr] = None,
+                       layer_attr=None) -> LayerOutput:
+    """Adds a learned [max_len, size] position table to a sequence."""
+    inp = _as_list(input)[0]
+    pas = [param_attr] if param_attr else None
+    return _add_layer(name, "position_embedding", inp.size,
+                      _mk_inputs([inp], pas),
+                      attrs={"max_len": max_len},
+                      layer_attr=layer_attr, param_attrs=pas)
+
+
+position_embedding_layer = position_embedding
+
+
 def dropout(input: Input, dropout_rate: float = 0.5,
             name: Optional[str] = None) -> LayerOutput:
     """v2 ``dropout`` = addto with drop_rate."""

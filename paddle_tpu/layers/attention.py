@@ -1,0 +1,158 @@
+"""Attention-family layers backed by the Pallas flash-attention kernel.
+
+The reference pattern for a hand kernel is kernel → layer → config
+(``paddle/cuda/src/hl_cuda_lstm.cu`` → ``LstmLayer`` → DSL
+``lstmemory``); this module is the same wiring for the repo's flash
+attention (:mod:`paddle_tpu.ops.pallas_attention`): the kernel is
+reachable from a config file via ``scaled_dot_product_attention`` /
+``multi_head_attention``, with ``layer_norm`` and ``position_embedding``
+alongside so a full transformer block can be declared in the v1/v2 DSL.
+
+These three types go beyond the 2017 reference's layer set (it predates
+transformers) — they are the TPU-era counterpart of what ``lstmemory``
+was to its era: the hot-path sequence mixer, hand-kernelled.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.model_config import ParameterConfig
+from ..core.sequence import SequenceBatch, like, value_of
+from ..ops.pallas_attention import flash_attention
+from ..utils import enforce
+from .base import Layer, register_layer
+
+
+def _seq_parts(x):
+    """(data [B, T, D], lengths [B] or None) from a layer input."""
+    if isinstance(x, SequenceBatch):
+        return x.data, x.length
+    return value_of(x), None
+
+
+@register_layer("scaled_dot_product_attention", "multi_head_attention",
+                "flash_attention")
+class MultiHeadAttentionLayer(Layer):
+    """Multi-head scaled-dot-product attention over padded sequences.
+
+    One input = self-attention (a packed [D_in, 3·size] q/k/v projection
+    — one MXU matmul instead of three); three inputs = (query, key,
+    value) cross-attention with per-input projections.  An output
+    projection ``_{name}.wo`` [size, size] merges the heads; bias (if
+    any) is added after it.  Attrs: ``num_heads`` (must divide size),
+    ``causal``, ``block_q``/``block_k`` (Pallas tile sizes).
+
+    Padded keys are masked inside the kernel via the scalar-prefetched
+    lengths of the key sequence; queries keep their own lengths on the
+    output SequenceBatch.
+    """
+
+    def param_specs(self):
+        size = self.conf.size
+        heads = self.conf.attrs.get("num_heads", 1)
+        enforce(size % heads == 0,
+                f"attention size {size} not divisible by num_heads {heads}")
+        ins = self.conf.inputs
+        enforce(len(ins) in (1, 3),
+                "attention takes 1 input (self) or 3 (q, k, v), got "
+                f"{len(ins)}")
+        specs = []
+        if len(ins) == 1:
+            din = self.model.find_size(ins[0].input_layer_name)
+            specs.append(self._weight_spec(0, (din, 3 * size),
+                                           initial_smart=True))
+        else:
+            for i, inp in enumerate(ins):
+                din = self.model.find_size(inp.input_layer_name)
+                specs.append(self._weight_spec(i, (din, size),
+                                               initial_smart=True))
+        specs.append(ParameterConfig(
+            name=f"_{self.name}.wo", size=size * size, dims=[size, size],
+            initial_smart=True))
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        size = self.conf.size
+        heads = self.conf.attrs.get("num_heads", 1)
+        dh = size // heads
+        if len(inputs) == 1:
+            x, q_len = _seq_parts(inputs[0])
+            qkv = x @ params[self.weight_name(0)]        # [B, T, 3·size]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            kv_len = q_len
+        else:
+            xq, q_len = _seq_parts(inputs[0])
+            xk, kv_len = _seq_parts(inputs[1])
+            xv, v_len = _seq_parts(inputs[2])
+            del v_len  # value lengths follow the key sequence
+            q = xq @ params[self.weight_name(0)]
+            k = xk @ params[self.weight_name(1)]
+            v = xv @ params[self.weight_name(2)]
+
+        b, tq = q.shape[0], q.shape[1]
+        tk = k.shape[1]
+        split = lambda a, t: a.reshape(b, t, heads, dh)
+        out = flash_attention(
+            split(q, tq), split(k, tk), split(v, tk), kv_len,
+            bool(self.conf.attrs.get("causal", False)),
+            int(self.conf.attrs.get("block_q", 512)),
+            int(self.conf.attrs.get("block_k", 512)))
+        out = out.reshape(b, tq, size) @ params[f"_{self.name}.wo"]
+        if self.conf.with_bias:
+            out = out + params[self.bias_name()].astype(out.dtype)
+        out = like(inputs[0], out) if isinstance(inputs[0], SequenceBatch) \
+            else out
+        return self.finalize(out, ctx)
+
+
+@register_layer("layer_norm")
+class LayerNormLayer(Layer):
+    """Per-position layer normalization with learned gain/bias.
+
+    Normalizes the last (feature) dim of [B, ..., size]; gain is the
+    weight of input 0, bias the layer bias (on unless bias_attr=False).
+    """
+
+    def param_specs(self):
+        specs = [self._weight_spec(0, (self.conf.size,), initial_mean=1.0,
+                                   initial_std=0.0)]
+        if self.conf.with_bias:
+            specs.append(self._bias_spec((self.conf.size,)))
+        return specs
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        eps = self.conf.attrs.get("epsilon", 1e-5)
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(axis=-1, keepdims=True)
+        var = jnp.square(xf - mu).mean(axis=-1, keepdims=True)
+        y = (xf - mu) / jnp.sqrt(var + eps)
+        y = y * params[self.weight_name(0)]
+        if self.conf.with_bias:
+            y = y + params[self.bias_name()]
+        return self.finalize(like(inputs[0], y.astype(x.dtype)), ctx)
+
+
+@register_layer("position_embedding")
+class PositionEmbeddingLayer(Layer):
+    """Adds a learned [max_len, size] position table to a sequence input
+    (sliced to the batch's T, so bucketed batches share one parameter)."""
+
+    def param_specs(self):
+        max_len = self.conf.attrs["max_len"]
+        return [self._weight_spec(0, (max_len, self.conf.size),
+                                  initial_std=0.01)]
+
+    def forward(self, params, inputs, ctx):
+        x = value_of(inputs[0])
+        table = params[self.weight_name(0)]
+        t = x.shape[1]
+        enforce(t <= table.shape[0],
+                f"sequence length {t} exceeds position_embedding max_len "
+                f"{table.shape[0]}")
+        out = x + table[:t][None, :, :].astype(x.dtype)
+        return self.finalize(like(inputs[0], out), ctx)

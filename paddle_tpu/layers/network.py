@@ -34,6 +34,7 @@ from .base import (
 from . import common, conv, cost, rnn, seq  # noqa: F401  (register layers)
 from . import detection, image3d  # noqa: F401  (register layers)
 from . import beam_search  # noqa: F401  (registers beam_gen)
+from . import attention  # noqa: F401  (registers flash-attention layers)
 
 
 class NeuralNetwork:

@@ -1,4 +1,4 @@
 """Model zoo: benchmark/demo network builders (reference ``benchmark/paddle``
 configs and ``v1_api_demo/model_zoo`` re-expressed with the TPU-native DSL)."""
 
-from .text import lstm_text_classifier  # noqa: F401
+from .text import lstm_text_classifier, transformer_text_classifier  # noqa: F401

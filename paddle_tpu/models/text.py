@@ -14,6 +14,43 @@ from ..data.feeder import integer_value, integer_value_sequence
 from ..v2.networks import simple_lstm
 
 
+def transformer_text_classifier(vocab_size: int = 30000,
+                                model_dim: int = 128, num_heads: int = 4,
+                                num_layers: int = 2, ffn_dim: int = 512,
+                                num_classes: int = 2,
+                                max_len: int = 2048,
+                                causal: bool = False) -> ModelConfig:
+    """Pre-LN transformer encoder classifier over the flash-attention
+    layer: embedding + position table → N × (LN → multi-head attention →
+    residual; LN → ffn → residual) → final LN → masked mean pool → fc
+    softmax → classification_cost.  The attention core is the Pallas
+    kernel (``ops/pallas_attention.py``) — this model is its product
+    surface, the way the reference's RNN benchmark fronts ``hl_lstm``.
+    """
+    with dsl.config_scope():
+        net = dsl.data("data", integer_value_sequence(vocab_size))
+        net = dsl.embedding(net, size=model_dim)
+        net = dsl.position_embedding(net, max_len=max_len)
+        for i in range(num_layers):
+            att = dsl.scaled_dot_product_attention(
+                dsl.layer_norm(net, name=f"ln{i}a"), size=model_dim,
+                num_heads=num_heads, causal=causal, name=f"attn{i}",
+                bias_attr=True)
+            net = dsl.addto([net, att], name=f"res{i}a")
+            ffn = dsl.fc(dsl.layer_norm(net, name=f"ln{i}f"),
+                         size=ffn_dim, act=dsl.Activation("relu"),
+                         name=f"ffn{i}_in")
+            ffn = dsl.fc(ffn, size=model_dim, name=f"ffn{i}_out")
+            net = dsl.addto([net, ffn], name=f"res{i}f")
+        net = dsl.layer_norm(net, name="ln_final")
+        net = dsl.pooling_layer(net, pooling_type=dsl.AvgPooling())
+        net = dsl.fc(net, size=num_classes,
+                     act=dsl.Activation("softmax"), name="cls")
+        lab = dsl.data("label", integer_value(num_classes))
+        cost = dsl.classification_cost(net, lab)
+        return dsl.topology(cost)
+
+
 def lstm_text_classifier(vocab_size: int = 30000, embed_dim: int = 128,
                          hidden_size: int = 512, lstm_num: int = 2,
                          num_classes: int = 2) -> ModelConfig:

@@ -46,11 +46,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-               scale, causal, block_q, block_k, n_kblocks):
+def _fa_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
+               acc_s, *, scale, causal, block_q, block_k, n_kblocks,
+               n_heads):
     """Grid (B·H, q_blocks, k_blocks); k innermost so the scratch
-    accumulators carry the online softmax across k steps."""
+    accumulators carry the online softmax across k steps.  ``len_ref``
+    is the scalar-prefetched int32 [B] of valid key lengths (padded
+    batches): keys at or past the length are masked to −inf, and k
+    blocks entirely inside the padding are skipped outright."""
     i_k = pl.program_id(2)
+    kv_len = len_ref[pl.program_id(0) // n_heads]
 
     @pl.when(i_k == 0)
     def _init():
@@ -66,12 +71,14 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         kb = k_ref[0]                                   # [bk, D]
         vb = v_ref[0]
         s = q @ kb.astype(jnp.float32).T                # [bq, bk]
+        ki = k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = ki < kv_len
         if causal:
             qi = q_off + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            ki = k_off + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qi >= ki, s, NEG_INF)
+            valid = jnp.logical_and(valid, qi >= ki)
+        s = jnp.where(valid, s, NEG_INF)
         m_prev = m_s[:]
         l_prev = l_s[:]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -81,110 +88,143 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         l_s[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
         acc_s[:] = acc_s[:] * alpha + p @ vb.astype(jnp.float32)
 
+    # skip k blocks with no valid key: fully above the causal diagonal
+    # or fully inside the padding
+    live = k_off < kv_len
     if causal:
-        # blocks fully above the diagonal contribute nothing — skip
-        pl.when(k_off <= q_off + block_q - 1)(_step)
-    else:
-        _step()
+        live = jnp.logical_and(live, k_off <= q_off + block_q - 1)
+    pl.when(live)(_step)
 
     @pl.when(i_k == n_kblocks - 1)
     def _flush():
-        o_ref[0] = (acc_s[:] / l_s[:]).astype(o_ref.dtype)
+        # guard fully-masked rows (query past a zero-length sequence):
+        # l = 0 → emit 0 not NaN, and clamp m away from NEG_INF so the
+        # backward's p = exp(s − lse) underflows to 0 instead of
+        # exp(NEG_INF − NEG_INF) = 1 leaking gradients into padding
+        l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        m_safe = jnp.maximum(m_s[:], NEG_INF / 2)
+        o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
         # lse block is (1, 8, bq) purely for TPU tiling (last two dims
         # must be (8k, 128k) or match the array); row 0 carries the data
         lse_ref[0] = jnp.broadcast_to(
-            (m_s[:] + jnp.log(l_s[:]))[:, 0][None, :], (8, block_q))
+            (m_safe + jnp.log(l_safe))[:, 0][None, :], (8, block_q))
 
 
-def _tiling_ok(t: int, bq: int, bk: int) -> bool:
+def _tiling_ok(tq: int, tk: int, bq: int, bk: int) -> bool:
     """Mosaic block constraints: the lse block's last dim (bq) must be a
-    multiple of 128 or equal T; the k/v block's penultimate dim (bk)
-    must be a multiple of 8 or equal T.  Checked on EVERY backend so
+    multiple of 128 or equal Tq; the k/v block's penultimate dim (bk)
+    must be a multiple of 8 or equal Tk.  Checked on EVERY backend so
     interpret-mode tests exercise the same dispatch as real TPU."""
-    ok_q = bq % 128 == 0 or bq == t
-    ok_k = bk % 8 == 0 or bk == t
+    ok_q = bq % 128 == 0 or bq == tq
+    ok_k = bk % 8 == 0 or bk == tk
     return ok_q and ok_k
 
 
-def _dense_forward(q, k, v, causal):
+def _mask_scores(s, causal, lengths):
+    """Apply causal and key-padding masks to [B, H, Tq, Tk] scores."""
+    tq, tk = s.shape[-2], s.shape[-1]
+    if causal:
+        s = jnp.where(jnp.arange(tq)[None, None, :, None]
+                      >= jnp.arange(tk)[None, None, None, :], s, NEG_INF)
+    if lengths is not None:
+        valid = jnp.arange(tk)[None, :] < lengths[:, None]   # [B, Tk]
+        s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    return s
+
+
+def _dense_forward(q, k, v, lengths, causal):
     """Fallback for shapes the kernel can't tile: plain XLA attention,
     same (out, lse) contract so the shared backward rule applies."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    if causal:
-        t = q.shape[1]
-        s = jnp.where(jnp.arange(t)[None, None, :, None]
-                      >= jnp.arange(t)[None, None, None, :], s, NEG_INF)
-    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    s = _mask_scores(s, causal, lengths)
+    m = s.max(axis=-1)
+    # fully-masked rows (query past a zero-length sequence): emit 0
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    l = jnp.exp(s - m_safe[..., None]).sum(axis=-1)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    lse = m_safe + jnp.log(l_safe)
     p = jnp.exp(s - lse[..., None])
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype), lse
 
 
-def _fa_forward(q, k, v, causal, block_q, block_k):
-    b, t, h, d = q.shape
-    bq = _choose_block(t, block_q)
-    bk = _choose_block(t, block_k)
-    if not _tiling_ok(t, bq, bk):
-        return _dense_forward(q, k, v, causal)
+def _fa_forward(q, k, v, lengths, causal, block_q, block_k):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if causal:
+        # a causal mask is only meaningful on a shared timeline
+        assert tq == tk, f"causal attention needs Tq == Tk, got {tq}/{tk}"
+    bq = _choose_block(tq, block_q)
+    bk = _choose_block(tk, block_k)
+    if lengths is None:
+        lengths = jnp.full((b,), tk, jnp.int32)
+    if not _tiling_ok(tq, tk, bq, bk):
+        return _dense_forward(q, k, v, lengths, causal)
     scale = 1.0 / np.sqrt(d)
     # [B, T, H, D] → [B*H, T, D] so one grid row owns one head
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    n_kblocks = t // bk
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    n_kblocks = tk // bk
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk,
-                               n_kblocks=n_kblocks)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // bq, n_kblocks),
+                               n_kblocks=n_kblocks, n_heads=h)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, tq // bq, n_kblocks),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, s: (i, s, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, s, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, s, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, s: (i, j, 0)),
-            pl.BlockSpec((1, 8, bq), lambda i, j, s: (i, 0, j)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 8, t), jnp.float32),
+            pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, j, 0)),
+            pl.BlockSpec((1, 8, bq), lambda i, j, s, *_: (i, 0, j)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),       # running max
             pltpu.VMEM((bq, 1), jnp.float32),       # running normalizer
             pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
         ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, tq), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qh, kh, vh)
-    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    lse = lse[:, 0, :].reshape(b, h, t)
+    )(lengths.astype(jnp.int32), qh, kh, vh)
+    out = out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, 0, :].reshape(b, h, tq)
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 512,
-                    block_k: int = 512):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, lengths=None, causal: bool = False,
+                    block_q: int = 512, block_k: int = 512):
     """softmax(q·kᵀ/√d)·v without materializing [T,T] scores in HBM.
 
     q, k, v: ``[B, T, H, D]``; returns ``[B, T, H, D]`` in q's dtype.
+    ``lengths``: optional int32 [B] valid key lengths for padded batches
+    — keys at or past the length are masked out of the softmax.
     """
-    out, _lse = _fa_forward(q, k, v, causal, block_q, block_k)
+    out, _lse = _fa_forward(q, k, v, lengths, causal, block_q, block_k)
     return out
 
 
-def _fa_fwd_rule(q, k, v, causal, block_q, block_k):
-    out, lse = _fa_forward(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _fa_fwd_rule(q, k, v, lengths, causal, block_q, block_k):
+    out, lse = _fa_forward(q, k, v, lengths, causal, block_q, block_k)
+    return out, (q, k, v, lengths, out, lse)
 
 
 def _fa_bwd_rule(causal, block_q, block_k, res, do):
-    q, k, v, out, lse = res
+    q, k, v, lengths, out, lse = res
     d = q.shape[-1]
     scale = 1.0 / np.sqrt(d)
     qf = q.astype(jnp.float32)
@@ -193,10 +233,7 @@ def _fa_bwd_rule(causal, block_q, block_k, res, do):
     dof = do.astype(jnp.float32)
     of = out.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    if causal:
-        t = q.shape[1]
-        s = jnp.where(jnp.arange(t)[None, None, :, None]
-                      >= jnp.arange(t)[None, None, None, :], s, NEG_INF)
+    s = _mask_scores(s, causal, lengths)
     p = jnp.exp(s - lse[:, :, :, None])                 # softmax weights
     dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
     dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
@@ -205,7 +242,8 @@ def _fa_bwd_rule(causal, block_q, block_k, res, do):
     ds = p * (dp - delta[:, :, :, None])
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None)
 
 
 flash_attention.defvjp(_fa_fwd_rule, _fa_bwd_rule)

@@ -80,19 +80,93 @@ def test_flash_attention_matches_full(causal, rng):
     B, T, H, D = 2, 64, 2, 16
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
                for _ in range(3))
-    out = flash_attention(q, k, v, causal, 64, 16)
+    out = flash_attention(q, k, v, None, causal, 64, 16)
     ref = full_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
 
     cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
     g_flash = jax.grad(
-        lambda *a: jnp.sum(flash_attention(*a, causal, 64, 16) * cot),
+        lambda *a: jnp.sum(flash_attention(*a, None, causal, 64, 16) * cot),
         argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(
         lambda *a: jnp.sum(full_attention(*a, causal=causal) * cot),
         argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_key_padding_lengths(causal, rng):
+    """lengths masks padded keys out of the softmax: the kernel result on
+    a padded batch equals dense attention over each row's valid prefix."""
+    from paddle_tpu.parallel import flash_attention
+
+    B, T, H, D = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+               for _ in range(3))
+    lengths = jnp.array([64, 40], jnp.int32)
+    out = flash_attention(q, k, v, lengths, causal, 64, 16)
+    for i, L in enumerate([64, 40]):
+        ref = full_attention(q[i:i + 1, :L], k[i:i + 1, :L],
+                             v[i:i + 1, :L], causal=causal)
+        np.testing.assert_allclose(np.asarray(out[i, :L]),
+                                   np.asarray(ref[0]),
+                                   rtol=2e-4, atol=2e-5)
+    # gradients must not leak through masked keys: dk/dv past the valid
+    # length are exactly zero
+    cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    gq, gk, gv = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, lengths, causal, 64, 16)
+                           * cot), argnums=(0, 1, 2))(q, k, v)
+    assert np.all(np.asarray(gk[1, 40:]) == 0)
+    assert np.all(np.asarray(gv[1, 40:]) == 0)
+    assert np.isfinite(np.asarray(gq)).all()
+
+
+def test_flash_attention_zero_length_row_grads_are_zero(rng):
+    """A zero-length sequence in the batch: forward emits 0 for every
+    query row AND backward leaks nothing into its keys/values (the lse
+    clamp — without it p = exp(NEG_INF − NEG_INF) = 1 in backward)."""
+    from paddle_tpu.parallel import flash_attention
+
+    B, T, H, D = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
+               for _ in range(3))
+    lengths = jnp.array([64, 0], jnp.int32)
+    out = flash_attention(q, k, v, lengths, False, 64, 16)
+    assert np.all(np.asarray(out[1]) == 0)
+    cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    gq, gk, gv = jax.grad(
+        lambda *a: jnp.sum(flash_attention(*a, lengths, False, 64, 16)
+                           * cot), argnums=(0, 1, 2))(q, k, v)
+    assert np.all(np.asarray(gk[1]) == 0)
+    assert np.all(np.asarray(gv[1]) == 0)
+    assert np.all(np.asarray(gq[1]) == 0)
+    assert np.isfinite(np.asarray(gq[0])).all()
+
+
+def test_flash_attention_rectangular_cross(rng):
+    """Tq != Tk (cross-attention over differently-padded batches) runs
+    through the kernel and matches dense attention, fwd + grad."""
+    from paddle_tpu.parallel import flash_attention
+
+    B, TQ, TK, H, D = 2, 32, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, TQ, H, D).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.randn(B, TK, H, D).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.randn(B, TK, H, D).astype(np.float32)) * 0.5
+    out = flash_attention(q, k, v, None, False, 32, 16)
+    ref = full_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    cot = jnp.asarray(rng.randn(B, TQ, H, D).astype(np.float32))
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, None, False, 32,
+                                                     16) * cot),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(full_attention(*a, causal=False)
+                                     * cot), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
 
@@ -104,15 +178,15 @@ def test_flash_attention_untileable_shape_falls_back(rng):
     from paddle_tpu.parallel import flash_attention
 
     B, T, H, D = 1, 48, 2, 16
-    assert not pa._tiling_ok(T, 16, 12)   # the gate must reject this
+    assert not pa._tiling_ok(T, T, 16, 12)   # the gate must reject this
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.5
                for _ in range(3))
-    out = flash_attention(q, k, v, True, 16, 12)
+    out = flash_attention(q, k, v, None, True, 16, 12)
     ref = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
     cot = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
-    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, True, 16, 12)
+    g1 = jax.grad(lambda *a: jnp.sum(flash_attention(*a, None, True, 16, 12)
                                      * cot), argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(lambda *a: jnp.sum(full_attention(*a, causal=True)
                                      * cot), argnums=(0, 1, 2))(q, k, v)
