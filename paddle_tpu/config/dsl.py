@@ -1098,11 +1098,27 @@ class StaticInput:
 def beam_search(step: Callable, input, bos_id: int, eos_id: int,
                 beam_size: int = 5, max_length: int = 100,
                 name: Optional[str] = None,
-                num_results_per_sample: Optional[int] = None) -> LayerOutput:
+                num_results_per_sample: Optional[int] = None,
+                candidate_adjust: Optional[Callable] = None,
+                candidate_drop: Optional[Callable] = None) -> LayerOutput:
     """Build a generating recurrent group decoded by beam search
     (``beam_search`` in ``trainer_config_helpers/layers.py``; executed
     TPU-side as a fixed-trip ``lax.scan`` with top-k expansion in
-    :mod:`paddle_tpu.layers.beam_search`)."""
+    :mod:`paddle_tpu.layers.beam_search`).
+
+    User candidate hooks — the ``beamSearchCandidateAdjust`` / drop
+    callbacks of ``RecurrentGradientMachine.h:73-112``, re-designed as
+    pure jax functions traced into the decode scan (no host
+    round-trips):
+
+    - ``candidate_adjust(logp, tokens, t) -> logp``: per-step token
+      log-probs ``[B, K, V]`` (before beam scores are added), tokens
+      decoded so far ``[B, K, max_length]``, scalar step ``t``; returns
+      adjusted same-shape log-probs.
+    - ``candidate_drop(logp, tokens, t) -> bool [B, K, V]``: True where
+      a candidate must be pruned (its score is forced to −inf before
+      top-k).
+    """
     name = name or _collector.unique_name("beam_search")
     sub = SubModelConfig(name=name, is_generating=True)
     ins = _as_list(input) if not isinstance(input, (list, tuple)) else \
@@ -1150,6 +1166,8 @@ def beam_search(step: Callable, input, bos_id: int, eos_id: int,
         "vocab_size": gen.size, "prob_layer": prob.name,
         "num_results_per_sample": num_results_per_sample or beam_size,
         "static_inputs": static_names,
+        "candidate_adjust": candidate_adjust,
+        "candidate_drop": candidate_drop,
     }
     _collector.sub_models.append(sub)
     # the group's visible result: generated token sequences (+scores);

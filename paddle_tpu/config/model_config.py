@@ -163,7 +163,20 @@ class ModelConfig:
         raise ConfigError(f"no layer or memory link named {name!r}")
 
     def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self), indent=1)
+        # beam-search candidate hooks (and any other runtime callables a
+        # config may carry) are code, not configuration — a dumped
+        # config regains them only from its source .py, so serialize a
+        # marker instead of crashing json.dumps
+        def scrub(v):
+            if callable(v):
+                return f"<callable {getattr(v, '__name__', 'fn')}>"
+            if isinstance(v, dict):
+                return {k: scrub(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [scrub(x) for x in v]
+            return v
+
+        return json.dumps(scrub(dataclasses.asdict(self)), indent=1)
 
     @staticmethod
     def from_json(text: str) -> "ModelConfig":

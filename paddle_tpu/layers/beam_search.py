@@ -87,6 +87,9 @@ class BeamSearchDecoder:
 
         batch_idx = jnp.arange(b)[:, None]                  # [B, 1]
 
+        adjust = g.get("candidate_adjust")
+        drop = g.get("candidate_drop")
+
         def step_fn(carry, t):
             last_ids, scores, alive, mems, tokens = carry
             new_mems, step_vals = group.step(
@@ -95,6 +98,13 @@ class BeamSearchDecoder:
             probs = value_of(step_vals[prob_name])          # [B*K, V]
             logp = jnp.log(jnp.maximum(probs, 1e-20))
             logp = logp.reshape(b, k, vocab)
+            # user candidate hooks (RecurrentGradientMachine.h:73-112),
+            # applied to live candidates before the finished-beam freeze
+            # so hooks can never resurrect a closed beam
+            if adjust is not None:
+                logp = adjust(logp, tokens, t)
+            if drop is not None:
+                logp = jnp.where(drop(logp, tokens, t), NEG_INF, logp)
             # finished beams may only continue with EOS at zero cost
             eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
             logp = jnp.where(alive[:, :, None], logp, eos_only)

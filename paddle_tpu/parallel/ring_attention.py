@@ -40,6 +40,16 @@ from ..utils import enforce
 NEG_INF = -1e30
 
 
+def _as_varying(x, axis_name):
+    """Type a replicated value as device-varying over ``axis_name`` so a
+    scan carry matches its (idx-dependent) updated value under
+    shard_map.  ``lax.pvary`` was deprecated for ``lax.pcast(...,
+    to='varying')`` mid-0.9; support both spellings."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))
+
+
 def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask):
     """One flash-attention block update.
 
@@ -81,10 +91,10 @@ def _local_ring(q, k, v, axis_name: str, causal: bool):
     b, tl, h, d = q.shape
     # initial carries must be typed as device-varying for the scan carry
     # to match the (idx-dependent) updated values under shard_map
-    m0 = lax.pvary(jnp.full((b, h, tl), NEG_INF, jnp.float32),
-                   (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b, h, tl), jnp.float32), (axis_name,))
-    o0 = lax.pvary(jnp.zeros((b, h, tl, d), jnp.float32), (axis_name,))
+    m0 = _as_varying(jnp.full((b, h, tl), NEG_INF, jnp.float32),
+                     axis_name)
+    l0 = _as_varying(jnp.zeros((b, h, tl), jnp.float32), axis_name)
+    o0 = _as_varying(jnp.zeros((b, h, tl, d), jnp.float32), axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     pos_q = idx * tl + jnp.arange(tl)
