@@ -87,8 +87,13 @@ class BeamSearchDecoder:
 
         batch_idx = jnp.arange(b)[:, None]                  # [B, 1]
 
+        # a config round-tripped through to_json/from_json carries the
+        # serialization markers ("<callable ...>"), not live hooks —
+        # hooks are code and only exist when built from the source .py
         adjust = g.get("candidate_adjust")
+        adjust = adjust if callable(adjust) else None
         drop = g.get("candidate_drop")
+        drop = drop if callable(drop) else None
 
         def step_fn(carry, t):
             last_ids, scores, alive, mems, tokens = carry

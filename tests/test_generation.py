@@ -25,7 +25,7 @@ VOCAB, EMB, HID = 10, 8, 24
 BOS, EOS = 0, 1
 
 
-def _gen_topology(beam_size, max_length=8):
+def _gen_topology(beam_size, max_length=8, adjust=None, drop=None):
     with config_scope():
         src = dsl.data("src", dense_vector(4))
         enc = dsl.fc(src, size=HID, act=dsl.TanhActivation(), name="enc")
@@ -43,7 +43,8 @@ def _gen_topology(beam_size, max_length=8):
                    GeneratedInput(size=VOCAB, embedding_name="_trg_emb",
                                   embedding_size=EMB)],
             bos_id=BOS, eos_id=EOS, beam_size=beam_size,
-            max_length=max_length)
+            max_length=max_length,
+            candidate_adjust=adjust, candidate_drop=drop)
         return dsl.topology(gen), gen
 
 
@@ -164,29 +165,6 @@ def test_train_then_generate_pattern():
         np.testing.assert_array_equal(best[b, :L], pattern)
 
 
-def _gen_topology_with_hooks(beam_size, max_length, adjust=None, drop=None):
-    with config_scope():
-        src = dsl.data("src", dense_vector(4))
-        enc = dsl.fc(src, size=HID, act=dsl.TanhActivation(), name="enc")
-
-        def step(enc_s, prev_emb):
-            mem = dsl.memory(name="dec_state", size=HID, boot_layer=enc_s)
-            h = dsl.fc([prev_emb, mem.out], size=HID,
-                       act=dsl.TanhActivation(), name="dec_state")
-            return dsl.fc(h, size=VOCAB, act=dsl.SoftmaxActivation(),
-                          name="dec_prob")
-
-        gen = dsl.beam_search(
-            step,
-            input=[StaticInput(enc),
-                   GeneratedInput(size=VOCAB, embedding_name="_trg_emb",
-                                  embedding_size=EMB)],
-            bos_id=BOS, eos_id=EOS, beam_size=beam_size,
-            max_length=max_length,
-            candidate_adjust=adjust, candidate_drop=drop)
-        return dsl.topology(gen), gen
-
-
 def test_beam_candidate_drop_hook_bans_token():
     """The RecurrentGradientMachine candidate-drop hook: banning a token
     id must remove it from every decoded sequence (and change the decode
@@ -208,7 +186,7 @@ def test_beam_candidate_drop_hook_bans_token():
         mask = jnp.zeros(logp.shape, bool)
         return mask.at[:, :, banned].set(True)
 
-    cfg1, gen1 = _gen_topology_with_hooks(3, 6, drop=drop)
+    cfg1, gen1 = _gen_topology(3, 6, drop=drop)
     net1 = NeuralNetwork(cfg1)
     values, _ = net1.forward(params, {"src": src}, {}, is_training=False)
     ids = np.asarray(values[gen1.name])
@@ -233,7 +211,7 @@ def test_beam_candidate_adjust_hook_steers_decode():
         boost = jnp.where(t == 0, 50.0, 0.0)
         return logp.at[:, :, target].add(boost)
 
-    cfg, gen = _gen_topology_with_hooks(2, 5, adjust=adjust)
+    cfg, gen = _gen_topology(2, 5, adjust=adjust)
     net = NeuralNetwork(cfg)
     params = net.init_params(seed=12)
     ids = np.asarray(net.forward(params, {"src": src}, {},
