@@ -1,0 +1,299 @@
+"""Fused LSTM sequence as Pallas TPU kernels.
+
+The reference hand-wrote exactly this kernel tier in CUDA
+(``paddle/cuda/src/hl_cuda_lstm.cu`` / ``hl_lstm_ops.cuh``: one kernel
+per step fusing the gate elementwise math, state kept in registers) —
+SURVEY §7 names the fused lstm step as the Pallas candidate for the
+latency-bound regime.  This module goes further than the reference: the
+ENTIRE time loop runs inside one kernel launch, with h/c carried in VMEM
+scratch across a sequential grid over T and the recurrent weight matrix
+resident in VMEM, so XLA's per-scan-step fixed costs (loop bookkeeping,
+HBM round-trips for the carry) disappear.
+
+Forward kernel (grid = (T,)): per step, gates = xw_t + h @ w_hh (MXU),
+peepholes + sigmoid/tanh gate math (VPU), length-masked state keep —
+writes the kept state sequences H, C and the activated gates (backward
+residual).
+
+Backward kernel (grid = (T,), reversed block maps): standard BPTT with
+dh/dc carries and the dW_hh / peephole-grad accumulators in VMEM f32
+scratch, one (dgates @ w_hhᵀ) + one (h_prevᵀ @ dgates) MXU matmul per
+step.
+
+Layouts are time-major ([T, B, ·]) so each grid step addresses one
+contiguous block.  Shapes that don't tile (B % 8, H % 128) or non-default
+activations dispatch to the ``lax.scan`` path in
+:mod:`paddle_tpu.ops.recurrent_ops` — same contract, same results.
+On non-TPU backends the kernels run in Pallas interpret mode so CPU
+tests exercise the exact dispatch used on hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+from .pallas_attention import _interpret  # shared backend-dispatch gate
+
+
+def fused_ok(b: int, h: int) -> bool:
+    """Mosaic tiling gate, checked on every backend so interpret-mode
+    tests exercise the hardware dispatch.  H is capped so the backward
+    kernel's resident f32 w_hh [H, 4H] (H·4H·4 B = 4 MB at H=512) plus
+    the dW_hh output accumulator (another 4 MB) plus the streamed
+    double-buffered blocks stay inside the 16 MB scoped-vmem budget."""
+    return b % 8 == 0 and h % 128 == 0 and h <= 512
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+# --------------------------------------------------------------- forward
+def _fwd_kernel(xw_ref, m_ref, whh_ref, ck_ref, h0_ref, c0_ref,
+                hseq_ref, cseq_ref, gates_ref, h_s, c_s):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_s[:] = h0_ref[...].astype(jnp.float32)
+        c_s[:] = c0_ref[...].astype(jnp.float32)
+
+    h_prev = h_s[:]                                     # [B, H] f32
+    c_prev = c_s[:]
+    hd = h_prev.shape[-1]
+    xw = xw_ref[0].astype(jnp.float32)                  # [B, 4H]
+    gates = xw + h_prev @ whh_ref[...].astype(jnp.float32)
+    pre_i = gates[:, :hd]
+    pre_f = gates[:, hd:2 * hd]
+    pre_c = gates[:, 2 * hd:3 * hd]
+    pre_o = gates[:, 3 * hd:]
+    # peepholes (row 0 = check_i, 1 = check_f, 2 = check_o)
+    ck = ck_ref[...].astype(jnp.float32)                # [8, H]
+    i = _sig(pre_i + c_prev * ck[0])
+    f = _sig(pre_f + c_prev * ck[1])
+    g = jnp.tanh(pre_c)
+    c = f * c_prev + i * g
+    o = _sig(pre_o + c * ck[2])
+    h = o * jnp.tanh(c)
+
+    m = m_ref[0, 0].astype(jnp.float32)[:, None]        # [B, 1]
+    h_keep = m * h + (1.0 - m) * h_prev
+    c_keep = m * c + (1.0 - m) * c_prev
+    h_s[:] = h_keep
+    c_s[:] = c_keep
+    hseq_ref[0] = h_keep.astype(hseq_ref.dtype)
+    cseq_ref[0] = c_keep.astype(cseq_ref.dtype)
+    gates_ref[0] = jnp.concatenate([i, f, g, o],
+                                   axis=-1).astype(gates_ref.dtype)
+
+
+def _fwd_call(xw, mask, w_hh, checks, h0, c0):
+    t, b, hd4 = xw.shape
+    hd = hd4 // 4
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, hd4), lambda i: (i, 0, 0)),   # xw
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0)),     # mask
+            pl.BlockSpec((hd, hd4), lambda i: (0, 0)),        # w_hh
+            pl.BlockSpec((8, hd), lambda i: (0, 0)),          # checks
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # h0
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hd), lambda i: (i, 0, 0)),    # H
+            pl.BlockSpec((1, b, hd), lambda i: (i, 0, 0)),    # C
+            pl.BlockSpec((1, b, hd4), lambda i: (i, 0, 0)),   # gates
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hd), jnp.float32),
+            jax.ShapeDtypeStruct((t, b, hd), jnp.float32),
+            jax.ShapeDtypeStruct((t, b, hd4), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hd), jnp.float32),                 # h carry
+            pltpu.VMEM((b, hd), jnp.float32),                 # c carry
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(xw, mask, w_hh, checks, h0, c0)
+
+
+# -------------------------------------------------------------- backward
+def _bwd_kernel(gates_ref, hprev_ref, cprev_ref, c_ref, m_ref, whh_ref,
+                ck_ref, dy_ref, dyc_ref, dxw_ref, dwhh_ref, dck_ref,
+                dh0_ref, dc0_ref, dh_s, dc_s, *, t_total):
+    """Grid step i visits t = T-1-i (the block index maps reverse time).
+    hprev/cprev blocks carry H_{t-1}/C_{t-1} (the wrapper passes the
+    state sequences shifted by one with h0/c0 prepended).  dy/dyc are
+    the external cotangents on the kept sequences H_t/C_t; they join the
+    recurrent carries BEFORE the masked split, so the (1−m) passthrough
+    forwards them to earlier steps exactly like the forward keep."""
+    i_rev = pl.program_id(0)
+
+    @pl.when(i_rev == 0)
+    def _init():
+        dh_s[:] = jnp.zeros_like(dh_s)
+        dc_s[:] = jnp.zeros_like(dc_s)
+        # dW/dck accumulate directly in their (constant-block) output
+        # refs — a second VMEM copy as scratch would overflow the 16 MB
+        # scoped-vmem budget at H=512
+        dwhh_ref[...] = jnp.zeros_like(dwhh_ref)
+        dck_ref[...] = jnp.zeros_like(dck_ref)
+
+    hd = dh_s.shape[-1]
+    gates = gates_ref[0].astype(jnp.float32)
+    g_i = gates[:, :hd]
+    g_f = gates[:, hd:2 * hd]
+    g_g = gates[:, 2 * hd:3 * hd]
+    g_o = gates[:, 3 * hd:]
+    h_prev = hprev_ref[0].astype(jnp.float32)
+    c_prev = cprev_ref[0].astype(jnp.float32)
+    c = c_ref[0].astype(jnp.float32)
+    ck = ck_ref[...].astype(jnp.float32)
+    m = m_ref[0, 0].astype(jnp.float32)[:, None]
+
+    tanh_c = jnp.tanh(c)
+    # total cotangents on the kept states H_t / C_t
+    dh_tot = dy_ref[0].astype(jnp.float32) + dh_s[:]
+    dc_tot = dyc_ref[0].astype(jnp.float32) + dc_s[:]
+    dh = m * dh_tot                                     # raw-h share
+    do_pre = dh * tanh_c * g_o * (1.0 - g_o)
+    dc = m * dc_tot + dh * g_o * (1.0 - tanh_c * tanh_c) \
+        + do_pre * ck[2]                                # raw-c share
+    di_pre = dc * g_g * g_i * (1.0 - g_i)
+    df_pre = dc * c_prev * g_f * (1.0 - g_f)
+    dg_pre = dc * g_i * (1.0 - g_g * g_g)
+    dgates = jnp.concatenate([di_pre, df_pre, dg_pre, do_pre], axis=-1)
+
+    dh_prev = dgates @ whh_ref[...].astype(jnp.float32).T
+    dc_prev = dc * g_f + di_pre * ck[0] + df_pre * ck[1]
+
+    dh_s[:] = (1.0 - m) * dh_tot + dh_prev
+    dc_s[:] = (1.0 - m) * dc_tot + dc_prev
+    dwhh_ref[...] = dwhh_ref[...] + h_prev.T @ dgates
+    dck_ref[0] = dck_ref[0] + jnp.sum(di_pre * c_prev, axis=0)
+    dck_ref[1] = dck_ref[1] + jnp.sum(df_pre * c_prev, axis=0)
+    dck_ref[2] = dck_ref[2] + jnp.sum(do_pre * c, axis=0)
+    dxw_ref[0] = dgates.astype(dxw_ref.dtype)
+
+    @pl.when(i_rev == t_total - 1)
+    def _flush():
+        dh0_ref[...] = dh_s[:].astype(dh0_ref.dtype)
+        dc0_ref[...] = dc_s[:].astype(dc0_ref.dtype)
+
+
+def _bwd_call(gates, h_prev_seq, c_prev_seq, c_seq, mask, w_hh, checks,
+              dy, dyc):
+    t, b, hd4 = gates.shape
+    hd = hd4 // 4
+    rev3 = lambda i: (t - 1 - i, 0, 0)
+    kernel = functools.partial(_bwd_kernel, t_total=t)
+    return pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, hd4), rev3),                  # gates
+            pl.BlockSpec((1, b, hd), rev3),                   # H_{t-1}
+            pl.BlockSpec((1, b, hd), rev3),                   # C_{t-1}
+            pl.BlockSpec((1, b, hd), rev3),                   # C_t
+            pl.BlockSpec((1, 1, b), lambda i: (t - 1 - i, 0, 0)),  # mask
+            pl.BlockSpec((hd, hd4), lambda i: (0, 0)),        # w_hh
+            pl.BlockSpec((8, hd), lambda i: (0, 0)),          # checks
+            pl.BlockSpec((1, b, hd), rev3),                   # dy (dH)
+            pl.BlockSpec((1, b, hd), rev3),                   # dyc (dC)
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hd4), rev3),                  # dxw
+            pl.BlockSpec((hd, hd4), lambda i: (0, 0)),        # dw_hh
+            pl.BlockSpec((8, hd), lambda i: (0, 0)),          # dchecks
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # dh0
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # dc0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hd4), jnp.float32),
+            jax.ShapeDtypeStruct((hd, hd4), jnp.float32),
+            jax.ShapeDtypeStruct((8, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hd), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hd), jnp.float32),                 # dh carry
+            pltpu.VMEM((b, hd), jnp.float32),                 # dc carry
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(gates, h_prev_seq, c_prev_seq, c_seq, mask, w_hh, checks, dy, dyc)
+
+
+# ------------------------------------------------------------ custom vjp
+@jax.custom_vjp
+def _lstm_core(xw, mask, w_hh, checks, h0, c0):
+    """xw [T, B, 4H] (input projection + bias already applied), mask
+    [T, B], w_hh [H, 4H], checks [8, H] (rows 0..2 = peephole i/f/o,
+    rest zero), h0/c0 [B, H].  Returns kept-state sequences
+    (H [T, B, Hd], C [T, B, Hd]) in f32."""
+    h_seq, c_seq, _gates = _fwd_call(xw, mask, w_hh, checks, h0, c0)
+    return h_seq, c_seq
+
+
+def _lstm_core_fwd(xw, mask, w_hh, checks, h0, c0):
+    h_seq, c_seq, gates = _fwd_call(xw, mask, w_hh, checks, h0, c0)
+    return (h_seq, c_seq), (gates, h_seq, c_seq, mask, w_hh, checks,
+                            h0, c0)
+
+
+def _lstm_core_bwd(res, cts):
+    gates, h_seq, c_seq, mask, w_hh, checks, h0, c0 = res
+    dh_seq, dc_seq = cts
+    # state sequences shifted one step back, boot state prepended
+    h_prev_seq = jnp.concatenate([h0[None].astype(h_seq.dtype),
+                                  h_seq[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([c0[None].astype(c_seq.dtype),
+                                  c_seq[:-1]], axis=0)
+    dxw, dw_hh, dck, dh0, dc0 = _bwd_call(
+        gates, h_prev_seq, c_prev_seq, c_seq, mask, w_hh, checks,
+        dh_seq, dc_seq)
+    # mask was cast to xw's dtype in the wrapper, so it carries the
+    # input dtype for the cotangent cast
+    return (dxw.astype(mask.dtype), jnp.zeros_like(mask), dw_hh,
+            dck, dh0, dc0)
+
+
+_lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
+
+
+def lstm_fused_sequence(xw, mask, w_hh, check_i, check_f, check_o,
+                        h0, c0):
+    """Batch-major wrapper: xw [B, T, 4H] pre-projected (+bias), mask
+    [B, T]; returns (y [B, T, H] masked hidden outputs, final_h [B, H],
+    final_c [B, H]) in f32 — callers cast per their dtype policy.
+    """
+    b, t, hd4 = xw.shape
+    hd = hd4 // 4
+    checks = jnp.zeros((8, hd), jnp.float32)
+    if check_i is not None:
+        checks = checks.at[0].set(check_i.astype(jnp.float32))
+        checks = checks.at[1].set(check_f.astype(jnp.float32))
+    if check_o is not None:
+        checks = checks.at[2].set(check_o.astype(jnp.float32))
+    h0 = jnp.zeros((b, hd), jnp.float32) if h0 is None \
+        else h0.astype(jnp.float32)
+    c0 = jnp.zeros((b, hd), jnp.float32) if c0 is None \
+        else c0.astype(jnp.float32)
+    h_seq, c_seq = _lstm_core(
+        jnp.moveaxis(xw, 1, 0),
+        jnp.moveaxis(mask, 1, 0).astype(xw.dtype)[:, None, :],
+        w_hh.astype(jnp.float32), checks, h0, c0)
+    y = jnp.moveaxis(h_seq, 0, 1) * mask.astype(jnp.float32)[:, :, None]
+    return y, h_seq[-1], c_seq[-1]

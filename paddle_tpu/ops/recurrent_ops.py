@@ -110,6 +110,28 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     if reverse:
         xw = xw[:, ::-1]
         mask = mask[:, ::-1]
+
+    # Fused whole-sequence Pallas kernel (the hl_cuda_lstm tier): one
+    # launch carries h/c across T in VMEM with w_hh resident — no
+    # per-scan-step XLA fixed costs.  Default activations + tileable
+    # shapes only; anything else takes the scan below.  The kernel does
+    # its gate math in f32 regardless of the bf16 policy (the VMEM
+    # carries are free to keep full precision), so under
+    # --bf16_activations it is a strict numerics upgrade over the bf16
+    # scan — equivalence in both regimes is pinned by
+    # tests/test_pallas_lstm.py.
+    if gate_act == "sigmoid" and cell_act == "tanh" and out_act == "tanh":
+        from .pallas_lstm import fused_ok, lstm_fused_sequence
+        if fused_ok(b, h_dim):
+            y, fh, fc = lstm_fused_sequence(
+                xw, mask, w_hh, check_i, check_f, check_o, h0, c0)
+            hs = y.astype(pol.output_dtype)
+            if reverse:
+                hs = hs[:, ::-1]
+            final = LstmState(h=fh.astype(pol.output_dtype),
+                              c=fc.astype(pol.output_dtype))
+            return SequenceBatch(data=hs, length=seq.length), final
+
     carry_dt = pol.output_dtype   # fp32 unless --bf16_activations
     init = LstmState(
         h=jnp.zeros((b, h_dim), carry_dt) if h0 is None

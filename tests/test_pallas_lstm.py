@@ -1,0 +1,149 @@
+"""Fused Pallas LSTM kernel ≡ the lax.scan path.
+
+The fused whole-sequence kernel (``ops/pallas_lstm.py``, the
+``hl_cuda_lstm.cu`` tier) must be numerically interchangeable with the
+scan implementation it replaces — forward, final state, and gradients
+through every parameter, on padded batches, with peepholes, both
+directions.  Runs in Pallas interpret mode on CPU (same dispatch gate as
+hardware).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import pallas_lstm, recurrent_ops
+
+B, T, H = 8, 12, 128
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def _inputs(rng, b=B, t=T, h=H, lens=None):
+    xw = jnp.asarray(rng.randn(b, t, 4 * h).astype(np.float32)) * 0.3
+    if lens is None:
+        lens = rng.randint(max(1, t // 2), t + 1, size=(b,))
+    seq = SequenceBatch(xw, jnp.asarray(lens, jnp.int32))
+    w_hh = jnp.asarray(rng.randn(h, 4 * h).astype(np.float32)) * 0.08
+    checks = [jnp.asarray(rng.randn(h).astype(np.float32)) * 0.1
+              for _ in range(3)]
+    return seq, w_hh, checks
+
+
+def _run(seq, w_hh, checks, reverse=False, fused=True, monkeypatch=None):
+    if not fused:
+        monkeypatch.setattr(pallas_lstm, "fused_ok",
+                            lambda *_: False)
+    out, final = recurrent_ops.lstm_sequence(
+        seq, None, w_hh, None, checks[0], checks[1], checks[2],
+        reverse=reverse)
+    return out.data, final.h, final.c
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_fused_forward_matches_scan(rng, reverse, monkeypatch):
+    seq, w_hh, checks = _inputs(rng)
+    got = _run(seq, w_hh, checks, reverse)
+    want = _run(seq, w_hh, checks, reverse, fused=False,
+                monkeypatch=monkeypatch)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fused_gradients_match_scan(rng, monkeypatch):
+    seq, w_hh, checks = _inputs(rng)
+    cot = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
+    cot_h = jnp.asarray(rng.randn(B, H).astype(np.float32))
+    cot_c = jnp.asarray(rng.randn(B, H).astype(np.float32))
+
+    def loss(xw, w, ci, cf, co):
+        out, final = recurrent_ops.lstm_sequence(
+            SequenceBatch(xw, seq.length), None, w, None, ci, cf, co)
+        # touch the hidden sequence AND both final states so the dc_seq
+        # cotangent pathway (cell read beyond the recurrence) is tested
+        return (jnp.sum(out.data * cot) + jnp.sum(final.h * cot_h)
+                + jnp.sum(final.c * cot_c))
+
+    args = (seq.data, w_hh, *checks)
+    g_fused = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(*args)
+    monkeypatch.setattr(pallas_lstm, "fused_ok", lambda *_: False)
+    g_scan = jax.grad(loss, argnums=(0, 1, 2, 3, 4))(*args)
+    for gf, gs in zip(g_fused, g_scan):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_fused_boot_state_and_grads(rng, monkeypatch):
+    seq, w_hh, checks = _inputs(rng)
+    h0 = jnp.asarray(rng.randn(B, H).astype(np.float32)) * 0.2
+    c0 = jnp.asarray(rng.randn(B, H).astype(np.float32)) * 0.2
+    cot = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
+
+    def loss(h0, c0):
+        out, _ = recurrent_ops.lstm_sequence(
+            seq, None, w_hh, None, checks[0], checks[1], checks[2],
+            h0=h0, c0=c0)
+        return jnp.sum(out.data * cot)
+
+    g_fused = jax.grad(loss, argnums=(0, 1))(h0, c0)
+    monkeypatch.setattr(pallas_lstm, "fused_ok", lambda *_: False)
+    g_scan = jax.grad(loss, argnums=(0, 1))(h0, c0)
+    for gf, gs in zip(g_fused, g_scan):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_fused_without_peepholes_matches_scan(rng, monkeypatch):
+    seq, w_hh, _ = _inputs(rng)
+
+    def run():
+        out, final = recurrent_ops.lstm_sequence(seq, None, w_hh, None)
+        return np.asarray(out.data), np.asarray(final.c)
+
+    got = run()
+    monkeypatch.setattr(pallas_lstm, "fused_ok", lambda *_: False)
+    want = run()
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_matches_scan_under_bf16_policy(rng, monkeypatch):
+    """The production-default bf16 policy: the fused kernel computes in
+    f32 internally (a numerics upgrade over the bf16 scan), so the two
+    paths must agree within bf16 rounding, not bit-exactly."""
+    from paddle_tpu.utils import FLAGS
+
+    FLAGS.set("bf16_activations", True)
+    try:
+        seq, w_hh, checks = _inputs(rng)
+        got = _run(seq, w_hh, checks)
+        monkeypatch.setattr(pallas_lstm, "fused_ok", lambda *_: False)
+        want = _run(seq, w_hh, checks, fused=False,
+                    monkeypatch=monkeypatch)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g, np.float32),
+                                       np.asarray(w, np.float32),
+                                       rtol=3e-2, atol=3e-2)
+    finally:
+        FLAGS.set("bf16_activations", False)
+
+
+def test_dispatch_gate():
+    # odd shapes and exotic activations must take the scan path
+    assert pallas_lstm.fused_ok(8, 128)
+    assert not pallas_lstm.fused_ok(7, 128)     # B % 8
+    assert not pallas_lstm.fused_ok(8, 96)      # H % 128
+    assert not pallas_lstm.fused_ok(8, 1024)    # VMEM cap
+    # non-default activation on a tileable shape still works (scan path)
+    rng = np.random.RandomState(1)
+    seq, w_hh, checks = _inputs(rng, b=8, t=4, h=128)
+    out, _ = recurrent_ops.lstm_sequence(
+        seq, None, w_hh, None, gate_act="sigmoid", cell_act="relu",
+        out_act="tanh")
+    assert np.isfinite(np.asarray(out.data)).all()
