@@ -78,6 +78,20 @@ def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
             and padding == [(3, 3), (3, 3)]
             and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
         return _stem_space_to_depth(x, w).astype(pol.output_dtype)
+    if (data_format == "NHWC" and groups == 1 and x.ndim == 4
+            and w.shape[:2] == (1, 1) and _pair(stride) == (1, 1)
+            and _pair(dilation) == (1, 1)
+            and padding in ("SAME", "VALID", [(0, 0), (0, 0)])):
+        # A 1×1 stride-1 conv IS a matmul over the flattened spatial
+        # dims; stating it as dot_general gives XLA the plain-GEMM
+        # layout space instead of the convolution lowering (half of
+        # ResNet-50's convs take this path; measured 2698 → 3065
+        # samples/s on the train step).  Stride-2 1×1 was tried as
+        # subsample-then-matmul and measured 25% WORSE (the strided
+        # slice's backward is a scatter) — those stay on lax.conv.
+        n, h, ww, cin = x.shape
+        out = (x.reshape(n * h * ww, cin) @ w.reshape(cin, w.shape[3]))
+        return out.reshape(n, h, ww, -1).astype(pol.output_dtype)
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         (data_format, "HWIO", data_format))
@@ -94,16 +108,36 @@ def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
 @register_op("conv2d_transpose")
 def conv2d_transpose(x, w, stride: IntOr2 = 1, padding="SAME",
                      data_format: str = "NHWC"):
-    """Transposed conv (``conv2d_transpose_op.cc``). w: [KH,KW,Cout,Cin]."""
+    """Transposed conv (``conv2d_transpose_op.cc``). w: [KH,KW,Cout,Cin].
+
+    Explicit padding follows the reference size contract
+    out = (i−1)·s + k − 2p, implemented as the scatter-conv identity:
+    conv of the stride-dilated input with the spatially-flipped filter
+    at padding k−1−p.  (``lax.conv_transpose`` with explicit padding
+    center-crops instead — wrong sizes for s > 1.)  String paddings keep
+    the lax fast path.
+    """
     pol = current_policy()
     x = x.astype(pol.compute_dtype)
     w = w.astype(pol.compute_dtype)
+    if isinstance(padding, str):
+        out = lax.conv_transpose(
+            x, w, strides=_pair(stride), padding=padding,
+            dimension_numbers=(data_format, "HWIO", data_format),
+            transpose_kernel=True)
+        return out.astype(pol.output_dtype)
     if isinstance(padding, int):
         padding = [(padding, padding)] * 2
-    out = lax.conv_transpose(
-        x, w, strides=_pair(stride), padding=padding,
-        dimension_numbers=(data_format, "HWIO", data_format),
-        transpose_kernel=True)
+    kh, kw = w.shape[0], w.shape[1]
+    # HWIO with I = Cin (matching x's channels), spatially flipped
+    w_flip = jnp.transpose(w, (0, 1, 3, 2))[::-1, ::-1]
+    dn = lax.conv_dimension_numbers(x.shape, w_flip.shape,
+                                    (data_format, "HWIO", data_format))
+    out = lax.conv_general_dilated(
+        x, w_flip, window_strides=(1, 1),
+        padding=[(kh - 1 - padding[0][0], kh - 1 - padding[0][1]),
+                 (kw - 1 - padding[1][0], kw - 1 - padding[1][1])],
+        lhs_dilation=_pair(stride), dimension_numbers=dn)
     return out.astype(pol.output_dtype)
 
 
