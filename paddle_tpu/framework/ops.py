@@ -334,9 +334,11 @@ def _conv2d(ctx, ins, attrs):
 def _conv2d_transpose(ctx, ins, attrs):
     x = value_of(_in(ins, "Input"))
     w = value_of(_in(ins, "Filter"))   # [Cin, Cout, KH, KW]
-    w_hwio = jnp.transpose(w, (2, 3, 0, 1))
     s = attrs.get("strides", [1, 1])
     p = attrs.get("paddings", [0, 0])
+    # helper wants [KH, KW, Cout, Cin]; it owns the reference
+    # (i-1)·s + k − 2p sizing for explicit padding
+    w_hwio = jnp.transpose(w, (2, 3, 1, 0))
     out = nn_ops.conv2d_transpose(x, w_hwio, stride=tuple(s),
                                   padding=[(p[0], p[0]), (p[1], p[1])],
                                   data_format="NCHW")
@@ -665,13 +667,18 @@ def _lstm(ctx, ins, attrs):
     enforce(isinstance(x, SequenceBatch), "lstm op wants LoD input")
     w = value_of(_in(ins, "Weight"))       # [H, 4H] recurrent weight
     bias = _in(ins, "Bias")
-    h, c = recurrent_ops.lstm_sequence(
+    # op contract (lstm_op.cc): candidate_activation acts on the
+    # candidate c̃; cell_activation acts on the cell when forming
+    # h = o·act(c).  lstm_gate_step's cell_act is the candidate slot
+    # and out_act the output slot, hence the cross mapping.
+    h_seq, final, c_seq = recurrent_ops.lstm_sequence(
         x, None, w, value_of(bias) if bias is not None else None,
         reverse=attrs.get("is_reverse", False),
         gate_act=attrs.get("gate_activation", "sigmoid"),
-        act=attrs.get("cell_activation", "tanh"))
-    return {"Hidden": [SequenceBatch(h, x.length)],
-            "Cell": [SequenceBatch(c, x.length)],
+        cell_act=attrs.get("candidate_activation", "tanh"),
+        out_act=attrs.get("cell_activation", "tanh"),
+        return_cells=True)
+    return {"Hidden": [h_seq], "Cell": [c_seq],
             "BatchGate": [x.data], "BatchCellPreAct": [x.data]}
 
 

@@ -276,8 +276,10 @@ _lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
 def lstm_fused_sequence(xw, mask, w_hh, check_i, check_f, check_o,
                         h0, c0):
     """Batch-major wrapper: xw [B, T, 4H] pre-projected (+bias), mask
-    [B, T]; returns (y [B, T, H] masked hidden outputs, final_h [B, H],
-    final_c [B, H]) in f32 — callers cast per their dtype policy.
+    [B, T]; returns (y [B, T, H] masked hidden outputs, cy [B, T, H]
+    masked cell outputs, final_h [B, H], final_c [B, H]) in f32 —
+    callers cast per their dtype policy.  XLA dead-code-eliminates the
+    cy mask-multiply when the caller drops it.
     """
     b, t, hd4 = xw.shape
     hd = hd4 // 4
@@ -295,5 +297,7 @@ def lstm_fused_sequence(xw, mask, w_hh, check_i, check_f, check_o,
         jnp.moveaxis(xw, 1, 0),
         jnp.moveaxis(mask, 1, 0).astype(xw.dtype)[:, None, :],
         w_hh.astype(jnp.float32), checks, h0, c0)
-    y = jnp.moveaxis(h_seq, 0, 1) * mask.astype(jnp.float32)[:, :, None]
-    return y, h_seq[-1], c_seq[-1]
+    m = mask.astype(jnp.float32)[:, :, None]
+    y = jnp.moveaxis(h_seq, 0, 1) * m
+    cy = jnp.moveaxis(c_seq, 0, 1) * m
+    return y, cy, h_seq[-1], c_seq[-1]

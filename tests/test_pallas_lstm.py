@@ -99,6 +99,25 @@ def test_fused_boot_state_and_grads(rng, monkeypatch):
                                    rtol=3e-4, atol=3e-5)
 
 
+def test_fused_cell_sequence_matches_scan(rng, monkeypatch):
+    """return_cells: the per-step cell sequence from the fused kernel's
+    C residue equals the scan path's collected cells (masked)."""
+    seq, w_hh, checks = _inputs(rng)
+
+    def run():
+        out, final, cells = recurrent_ops.lstm_sequence(
+            seq, None, w_hh, None, checks[0], checks[1], checks[2],
+            return_cells=True)
+        return (np.asarray(out.data), np.asarray(cells.data),
+                np.asarray(final.c))
+
+    got = run()
+    monkeypatch.setattr(pallas_lstm, "fused_ok", lambda *_: False)
+    want = run()
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-5)
+
+
 def test_fused_without_peepholes_matches_scan(rng, monkeypatch):
     seq, w_hh, _ = _inputs(rng)
 
