@@ -122,23 +122,23 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     # --bf16_activations it is a strict numerics upgrade over the bf16
     # scan — equivalence in both regimes is pinned by
     # tests/test_pallas_lstm.py.
+    def pack(arr):
+        """Cast to the policy dtype, undo time reversal, wrap."""
+        arr = arr.astype(pol.output_dtype)
+        if reverse:
+            arr = arr[:, ::-1]
+        return SequenceBatch(data=arr, length=seq.length)
+
     if gate_act == "sigmoid" and cell_act == "tanh" and out_act == "tanh":
         from .pallas_lstm import fused_ok, lstm_fused_sequence
         if fused_ok(b, h_dim):
             y, cy, fh, fc = lstm_fused_sequence(
                 xw, mask, w_hh, check_i, check_f, check_o, h0, c0)
-            hs = y.astype(pol.output_dtype)
-            if reverse:
-                hs = hs[:, ::-1]
             final = LstmState(h=fh.astype(pol.output_dtype),
                               c=fc.astype(pol.output_dtype))
-            out = SequenceBatch(data=hs, length=seq.length)
             if return_cells:
-                cs = cy.astype(pol.output_dtype)
-                if reverse:
-                    cs = cs[:, ::-1]
-                return out, final, SequenceBatch(cs, seq.length)
-            return out, final
+                return pack(y), final, pack(cy)
+            return pack(y), final
 
     carry_dt = pol.output_dtype   # fp32 unless --bf16_activations
     init = LstmState(
@@ -162,19 +162,12 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     final, ys = lax.scan(step, init,
                          (jnp.moveaxis(xw, 1, 0), jnp.moveaxis(mask, 1, 0)),
                          unroll=_UNROLL)
-    hs = ys[0] if return_cells else ys
-    hs = jnp.moveaxis(hs, 0, 1).astype(pol.output_dtype)
-    if reverse:
-        hs = hs[:, ::-1]
     final = LstmState(h=final.h.astype(pol.output_dtype),
                       c=final.c.astype(pol.output_dtype))
-    out = SequenceBatch(data=hs, length=seq.length)
     if return_cells:
-        cs = jnp.moveaxis(ys[1], 0, 1).astype(pol.output_dtype)
-        if reverse:
-            cs = cs[:, ::-1]
-        return out, final, SequenceBatch(cs, seq.length)
-    return out, final
+        return (pack(jnp.moveaxis(ys[0], 0, 1)), final,
+                pack(jnp.moveaxis(ys[1], 0, 1)))
+    return pack(jnp.moveaxis(ys, 0, 1)), final
 
 
 @register_op("gru")

@@ -880,12 +880,15 @@ def test_metrics_auc_precision_recall():
 
 
 # ---------------------------------------------------- coverage closure
-def test_registry_fully_covered():
+def test_registry_fully_covered(request):
     """Every registered framework op went through this harness — the
     registry-generated assertion VERDICT r4 asked for.  Runs last in the
-    file (pytest executes in definition order); running a -k subset
-    skips it via the sentinel check."""
-    if len(COVERED) < 50:     # a -k subset ran; don't false-alarm
-        pytest.skip("partial run")
+    file (pytest executes in definition order).  Skips whenever the
+    accounting could be incomplete: -k/-m deselection or a split
+    (xdist) run, where COVERED only saw this worker's share."""
+    import os
+    if (request.config.option.keyword or request.config.option.markexpr
+            or os.environ.get("PYTEST_XDIST_WORKER")):
+        pytest.skip("partial or split run: coverage accounting incomplete")
     missing = sorted(set(OPS.keys()) - COVERED)
     assert not missing, f"ops never exercised by the suite: {missing}"
