@@ -1,0 +1,211 @@
+"""Fused GRU sequence as Pallas TPU kernels.
+
+Companion to :mod:`paddle_tpu.ops.pallas_lstm` — the second half of the
+``hl_cuda_lstm.cu`` / ``hl_cuda_gru`` kernel tier SURVEY §7 names.  The
+whole time loop runs in one launch: h carried in VMEM f32 scratch, both
+recurrent weights (w_gates [H, 2H], w_cand [H, H]) resident, per step
+two MXU matmuls (gate and candidate projections) plus the sigmoid/tanh
+gate math on the VPU, with the length-masked keep.  Backward is a
+reversed-grid BPTT kernel accumulating dW directly in constant-block
+output refs.  Gate layout (u, r, c) and the update rule
+``h' = u·h + (1−u)·c`` match ``recurrent_ops.gru_sequence`` exactly —
+equivalence is pinned by ``tests/test_pallas_gru.py``.
+
+Same dispatch contract as the LSTM kernel: default activations and
+tileable shapes only; anything else takes the ``lax.scan`` path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_attention import _interpret
+from .pallas_lstm import fused_ok  # same B/H tiling + VMEM gate
+
+
+def _sig(x):
+    return jax.nn.sigmoid(x)
+
+
+# --------------------------------------------------------------- forward
+def _fwd_kernel(xw_ref, m_ref, wg_ref, wc_ref, h0_ref, hseq_ref,
+                gates_ref, h_s):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_s[:] = h0_ref[...].astype(jnp.float32)
+
+    h_prev = h_s[:]                                     # [B, H] f32
+    hd = h_prev.shape[-1]
+    xw = xw_ref[0].astype(jnp.float32)                  # [B, 3H]
+    xu = xw[:, :hd]
+    xr = xw[:, hd:2 * hd]
+    xc = xw[:, 2 * hd:]
+    g = h_prev @ wg_ref[...].astype(jnp.float32)        # [B, 2H]
+    u = _sig(xu + g[:, :hd])
+    r = _sig(xr + g[:, hd:])
+    c = jnp.tanh(xc + (r * h_prev) @ wc_ref[...].astype(jnp.float32))
+    h_new = u * h_prev + (1.0 - u) * c
+
+    m = m_ref[0, 0].astype(jnp.float32)[:, None]        # [B, 1]
+    h_keep = m * h_new + (1.0 - m) * h_prev
+    h_s[:] = h_keep
+    hseq_ref[0] = h_keep.astype(hseq_ref.dtype)
+    gates_ref[0] = jnp.concatenate([u, r, c],
+                                   axis=-1).astype(gates_ref.dtype)
+
+
+def _fwd_call(xw, mask, w_gates, w_cand, h0):
+    t, b, hd3 = xw.shape
+    hd = hd3 // 3
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, hd3), lambda i: (i, 0, 0)),   # xw
+            pl.BlockSpec((1, 1, b), lambda i: (i, 0, 0)),     # mask
+            pl.BlockSpec((hd, 2 * hd), lambda i: (0, 0)),     # w_gates
+            pl.BlockSpec((hd, hd), lambda i: (0, 0)),         # w_cand
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # h0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hd), lambda i: (i, 0, 0)),    # H
+            pl.BlockSpec((1, b, hd3), lambda i: (i, 0, 0)),   # gates
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hd), jnp.float32),
+            jax.ShapeDtypeStruct((t, b, hd3), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, hd), jnp.float32)],    # h carry
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(xw, mask, w_gates, w_cand, h0)
+
+
+# -------------------------------------------------------------- backward
+def _bwd_kernel(gates_ref, hprev_ref, m_ref, wg_ref, wc_ref, dy_ref,
+                dxw_ref, dwg_ref, dwc_ref, dh0_ref, dh_s, *, t_total):
+    """Grid step i visits t = T-1-i.  dy is the external cotangent on
+    the kept H_t; it joins the carry BEFORE the masked split so the
+    (1−m) passthrough mirrors the forward keep."""
+    i_rev = pl.program_id(0)
+
+    @pl.when(i_rev == 0)
+    def _init():
+        dh_s[:] = jnp.zeros_like(dh_s)
+        dwg_ref[...] = jnp.zeros_like(dwg_ref)
+        dwc_ref[...] = jnp.zeros_like(dwc_ref)
+
+    hd = dh_s.shape[-1]
+    gates = gates_ref[0].astype(jnp.float32)
+    u = gates[:, :hd]
+    r = gates[:, hd:2 * hd]
+    c = gates[:, 2 * hd:]
+    h_prev = hprev_ref[0].astype(jnp.float32)
+    m = m_ref[0, 0].astype(jnp.float32)[:, None]
+
+    dh_tot = dy_ref[0].astype(jnp.float32) + dh_s[:]
+    dh_new = m * dh_tot                                 # raw-h' share
+    du_pre = dh_new * (h_prev - c) * u * (1.0 - u)
+    dc_pre = dh_new * (1.0 - u) * (1.0 - c * c)
+    drh = dc_pre @ wc_ref[...].astype(jnp.float32).T    # d(r·h_prev)
+    dr_pre = drh * h_prev * r * (1.0 - r)
+    dg = jnp.concatenate([du_pre, dr_pre], axis=-1)     # [B, 2H]
+
+    dh_prev = (dh_new * u + drh * r
+               + dg @ wg_ref[...].astype(jnp.float32).T)
+    dh_s[:] = (1.0 - m) * dh_tot + dh_prev
+    dwg_ref[...] = dwg_ref[...] + h_prev.T @ dg
+    dwc_ref[...] = dwc_ref[...] + (r * h_prev).T @ dc_pre
+    dxw_ref[0] = jnp.concatenate([du_pre, dr_pre, dc_pre],
+                                 axis=-1).astype(dxw_ref.dtype)
+
+    @pl.when(i_rev == t_total - 1)
+    def _flush():
+        dh0_ref[...] = dh_s[:].astype(dh0_ref.dtype)
+
+
+def _bwd_call(gates, h_prev_seq, mask, w_gates, w_cand, dy):
+    t, b, hd3 = gates.shape
+    hd = hd3 // 3
+    rev3 = lambda i: (t - 1 - i, 0, 0)
+    kernel = functools.partial(_bwd_kernel, t_total=t)
+    return pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, hd3), rev3),                  # gates
+            pl.BlockSpec((1, b, hd), rev3),                   # H_{t-1}
+            pl.BlockSpec((1, 1, b), lambda i: (t - 1 - i, 0, 0)),  # mask
+            pl.BlockSpec((hd, 2 * hd), lambda i: (0, 0)),     # w_gates
+            pl.BlockSpec((hd, hd), lambda i: (0, 0)),         # w_cand
+            pl.BlockSpec((1, b, hd), rev3),                   # dy (dH)
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hd3), rev3),                  # dxw
+            pl.BlockSpec((hd, 2 * hd), lambda i: (0, 0)),     # dw_gates
+            pl.BlockSpec((hd, hd), lambda i: (0, 0)),         # dw_cand
+            pl.BlockSpec((b, hd), lambda i: (0, 0)),          # dh0
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hd3), jnp.float32),
+            jax.ShapeDtypeStruct((hd, 2 * hd), jnp.float32),
+            jax.ShapeDtypeStruct((hd, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, hd), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, hd), jnp.float32)],    # dh carry
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(gates, h_prev_seq, mask, w_gates, w_cand, dy)
+
+
+# ------------------------------------------------------------ custom vjp
+@jax.custom_vjp
+def _gru_core(xw, mask, w_gates, w_cand, h0):
+    """xw [T, B, 3H] (input projection + bias applied), mask [T, 1, B],
+    w_gates [H, 2H], w_cand [H, H], h0 [B, H].  Returns the kept state
+    sequence H [T, B, Hd] in f32."""
+    h_seq, _gates = _fwd_call(xw, mask, w_gates, w_cand, h0)
+    return h_seq
+
+
+def _gru_core_fwd(xw, mask, w_gates, w_cand, h0):
+    h_seq, gates = _fwd_call(xw, mask, w_gates, w_cand, h0)
+    return h_seq, (gates, h_seq, mask, w_gates, w_cand, h0)
+
+
+def _gru_core_bwd(res, dh_seq):
+    gates, h_seq, mask, w_gates, w_cand, h0 = res
+    h_prev_seq = jnp.concatenate([h0[None].astype(h_seq.dtype),
+                                  h_seq[:-1]], axis=0)
+    dxw, dwg, dwc, dh0 = _bwd_call(gates, h_prev_seq, mask, w_gates,
+                                   w_cand, dh_seq)
+    return (dxw.astype(mask.dtype), jnp.zeros_like(mask), dwg, dwc, dh0)
+
+
+_gru_core.defvjp(_gru_core_fwd, _gru_core_bwd)
+
+
+def gru_fused_sequence(xw, mask, w_gates, w_cand, h0):
+    """Batch-major wrapper: xw [B, T, 3H] pre-projected (+bias), mask
+    [B, T]; returns (y [B, T, H] masked hidden outputs, final_h [B, H])
+    in f32 — callers cast per their dtype policy."""
+    b, t, hd3 = xw.shape
+    hd = hd3 // 3
+    h0 = jnp.zeros((b, hd), jnp.float32) if h0 is None \
+        else h0.astype(jnp.float32)
+    h_seq = _gru_core(
+        jnp.moveaxis(xw, 1, 0),
+        jnp.moveaxis(mask, 1, 0).astype(xw.dtype)[:, None, :],
+        w_gates.astype(jnp.float32), w_cand.astype(jnp.float32), h0)
+    y = jnp.moveaxis(h_seq, 0, 1) * mask.astype(jnp.float32)[:, :, None]
+    return y, h_seq[-1]

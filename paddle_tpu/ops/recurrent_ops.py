@@ -194,6 +194,19 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
     if reverse:
         xw = xw[:, ::-1]
         mask = mask[:, ::-1]
+    # Fused whole-sequence Pallas kernel (see pallas_lstm.py — same
+    # dispatch contract; gate math is f32 regardless of policy)
+    if gate_act == "sigmoid" and act == "tanh":
+        from .pallas_gru import fused_ok, gru_fused_sequence
+        if fused_ok(b, h_dim):
+            y, fh = gru_fused_sequence(xw, mask, w_hh[:, :2 * h_dim],
+                                       w_hh[:, 2 * h_dim:], h0)
+            hs = y.astype(pol.output_dtype)
+            if reverse:
+                hs = hs[:, ::-1]
+            return SequenceBatch(data=hs, length=seq.length), \
+                fh.astype(pol.output_dtype)
+
     w_gates = w_hh[:, : 2 * h_dim].astype(cd)
     w_cand = w_hh[:, 2 * h_dim:].astype(cd)
     ga = get_activation(gate_act)

@@ -102,7 +102,7 @@ CASES = {
     "scale_shift": (dict(size=4, input_sizes=[4], with_bias=True),
                     lambda: {"in0": _d(3, 4)}, "grad"),
     "prelu": (dict(size=4, input_sizes=[4]),
-              lambda: {"in0": _d(3, 4) + jnp.sign(_d(3, 4)) * 0.3},
+              lambda: (lambda x: {"in0": x + jnp.sign(x) * 0.3})(_d(3, 4)),
               "grad"),
     "multiplex": (dict(size=4, input_sizes=[1, 4, 4]),
                   lambda: {"in0": jnp.asarray([[0], [1], [0]], jnp.int32),
