@@ -12,10 +12,11 @@ Layout matches :mod:`paddle_tpu.parallel.ring_attention`'s
 ``full_attention``: q, k, v are ``[B, T, H, D]``; output ``[B, T, H, D]``.
 
 Backward: custom VJP with the standard recomputation formulation — the
-saved residuals are (q, k, v, out, per-row logsumexp); gradients are
-einsums (XLA/MXU-friendly).  The O(T²) score matrix does get rebuilt in
-backward; the forward memory saving (what bounds sequence length at
-inference and in activation-checkpointed training) is kept.
+saved residuals are (q, k, v, out, per-row logsumexp).  When the shapes
+tile, backward runs as TWO Pallas kernels (a dq pass streaming k/v and
+a dk/dv pass streaming q/do, each rebuilding p blockwise from the saved
+logsumexp) so the [T, T] score matrix never exists in HBM in either
+direction; otherwise it falls back to dense einsums.
 
 On non-TPU backends the kernel runs in Pallas interpret mode so the CPU
 test mesh exercises the exact same code path.
@@ -205,6 +206,184 @@ def _fa_forward(q, k, v, lengths, causal, block_q, block_k):
     return out, lse
 
 
+# ------------------------------------------------------ backward kernels
+def _recompute_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     q_off, k_off, kv_len, scale, causal, block_q,
+                     block_k):
+    """Rebuild one (q-block, k-block) softmax tile from the saved
+    logsumexp and return (p, ds, q, kb, do) in f32 — shared by the dq
+    and dk/dv kernels so their masking/scaling can never diverge."""
+    q = q_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]         # [bq, 1]
+    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
+    s = (q @ kb.T) * scale
+    ki = k_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = ki < kv_len
+    if causal:
+        qi = q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = jnp.logical_and(valid, qi >= ki)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    ds = p * (do @ vb.T - delta)
+    return p, ds, q, kb, do
+
+
+def _bwd_live(q_off, k_off, kv_len, causal, block_q):
+    """Skip condition shared by both backward kernels: a block with no
+    valid key (padding tail or fully above the causal diagonal)."""
+    live = k_off < kv_len
+    if causal:
+        live = jnp.logical_and(live, k_off <= q_off + block_q - 1)
+    return live
+
+
+def _bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, acc_s, *, scale, causal, block_q,
+                   block_k, n_kblocks, n_heads):
+    """Grid (B·H, q_blocks, k_blocks), k innermost: accumulate dq for
+    one q block while k/v stream through VMEM."""
+    i_k = pl.program_id(2)
+    kv_len = len_ref[pl.program_id(0) // n_heads]
+
+    @pl.when(i_k == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    q_off = pl.program_id(1) * block_q
+    k_off = i_k * block_k
+
+    def _step():
+        _p, ds, _q, kb, _do = _recompute_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_off,
+            k_off, kv_len, scale, causal, block_q, block_k)
+        acc_s[:] = acc_s[:] + ds @ kb * scale
+
+    pl.when(_bwd_live(q_off, k_off, kv_len, causal, block_q))(_step)
+
+    @pl.when(i_k == n_kblocks - 1)
+    def _flush():
+        dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_s, dv_s, *, scale,
+                    causal, block_q, block_k, n_qblocks, n_heads):
+    """Grid (B·H, k_blocks, q_blocks), q innermost: accumulate dk/dv
+    for one k block while q/do stream through VMEM."""
+    i_q = pl.program_id(2)
+    kv_len = len_ref[pl.program_id(0) // n_heads]
+
+    @pl.when(i_q == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    k_off = pl.program_id(1) * block_k
+    q_off = i_q * block_q
+
+    def _step():
+        p, ds, q, _kb, do = _recompute_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_off,
+            k_off, kv_len, scale, causal, block_q, block_k)
+        dv_s[:] = dv_s[:] + p.T @ do
+        dk_s[:] = dk_s[:] + ds.T @ q * scale
+
+    pl.when(_bwd_live(q_off, k_off, kv_len, causal, block_q))(_step)
+
+    @pl.when(i_q == n_qblocks - 1)
+    def _flush():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _fa_backward_pallas(q, k, v, lengths, out, lse, do, causal, bq, bk):
+    """Blockwise backward: (dq, dk, dv) without a [T, T] score matrix
+    in HBM.  q/do layouts as in forward ([B, T, H, D])."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    doh = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    # delta_i = Σ_d dO_i·O_i (softmax-backward row term), [BH, 1, T]
+    delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                       out.astype(jnp.float32)).reshape(b * h, 1, tq)
+    lse3 = lse.reshape(b * h, 1, tq)
+    if lengths is None:
+        lengths = jnp.full((b,), tk, jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    common = dict(
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_kblocks=tk // bk,
+                          n_heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, tq // bq, tk // bk),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, s, 0)),
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, s, 0)),
+                pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, j, 0)),
+                pl.BlockSpec((1, 1, bq), lambda i, j, s, *_: (i, 0, j)),
+                pl.BlockSpec((1, 1, bq), lambda i, j, s, *_: (i, 0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32)],
+        **common,
+    )(lengths, qh, kh, vh, doh, lse3, delta)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, n_qblocks=tq // bq,
+                          n_heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, tk // bk, tq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, s, 0)),
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, j, 0)),
+                pl.BlockSpec((1, bq, d), lambda i, j, s, *_: (i, s, 0)),
+                pl.BlockSpec((1, 1, bq), lambda i, j, s, *_: (i, 0, s)),
+                pl.BlockSpec((1, 1, bq), lambda i, j, s, *_: (i, 0, s)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda i, j, s, *_: (i, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32),
+        ],
+        **common,
+    )(lengths, qh, kh, vh, doh, lse3, delta)
+
+    unpack_q = lambda a: a.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    unpack_k = lambda a: a.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    return (unpack_q(dq).astype(q.dtype), unpack_k(dk).astype(k.dtype),
+            unpack_k(dv).astype(v.dtype))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention(q, k, v, lengths=None, causal: bool = False,
                     block_q: int = 512, block_k: int = 512):
@@ -226,6 +405,13 @@ def _fa_fwd_rule(q, k, v, lengths, causal, block_q, block_k):
 def _fa_bwd_rule(causal, block_q, block_k, res, do):
     q, k, v, lengths, out, lse = res
     d = q.shape[-1]
+    tq, tk = q.shape[1], k.shape[1]
+    bq = _choose_block(tq, block_q)
+    bk = _choose_block(tk, block_k)
+    if _tiling_ok(tq, tk, bq, bk):
+        dq, dk, dv = _fa_backward_pallas(q, k, v, lengths, out, lse, do,
+                                         causal, bq, bk)
+        return dq, dk, dv, None
     scale = 1.0 / np.sqrt(d)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
