@@ -340,7 +340,10 @@ def bench_attention():
     from paddle_tpu.core.sequence import SequenceBatch
     from paddle_tpu.models import transformer_text_classifier
 
-    B, T, D, HEADS, L, F, V = 8, 2048, 512, 8, 4, 2048, 30000
+    # B swept with the Pallas backward: 8 → 432k, 16 → 463k (best),
+    # 32 → 427k tokens/s (pre-Pallas-backward, B=16 lost to B=8 —
+    # the dense einsum backward's HBM pressure)
+    B, T, D, HEADS, L, F, V = 16, 2048, 512, 8, 4, 2048, 30000
     cfg = transformer_text_classifier(
         vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
         ffn_dim=F, num_classes=2, max_len=T)
