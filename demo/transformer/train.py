@@ -1,0 +1,74 @@
+"""Transformer text-classification demo — the flash-attention kernel's
+demo surface (kernel → layer → model → demo, the wiring the reference
+used for ``hl_lstm`` → ``lstmemory`` → ``demo/sentiment``).
+
+A pre-LN transformer encoder (embedding + learned positions → N ×
+[LN → multi-head flash attention → residual; LN → ffn → residual] →
+masked mean pool → softmax) classifies IMDB sentiment through the
+standard v2 event loop.
+
+Run: python demo/transformer/train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config import dsl
+from paddle_tpu.models.text import transformer_classifier_cost
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.utils import FLAGS
+
+MAX_LEN = 512
+
+
+def build_classifier(vocab_size: int, num_classes: int = 2):
+    """The model-zoo builder at demo scale — one shared topology, so
+    zoo, demo, and test can't drift."""
+    return transformer_classifier_cost(
+        vocab_size, model_dim=64, num_heads=4, num_layers=2,
+        ffn_dim=128, num_classes=num_classes, max_len=MAX_LEN,
+        data_name="word")
+
+
+def truncate(reader):
+    """IMDB reviews are untruncated and can exceed MAX_LEN; the
+    position table is finite, so clip the tail (standard practice)."""
+    def r():
+        for seq, label in reader():
+            yield seq[:MAX_LEN], label
+    return r
+
+
+def main():
+    FLAGS.set("save_dir", "")
+    word_dict = paddle.dataset.imdb.word_dict()
+    with dsl.config_scope():
+        cost = build_classifier(len(word_dict))
+        trainer = paddle.trainer.SGD(
+            cost, update_equation=paddle.optimizer.Adam(
+                learning_rate=1e-3))
+
+        def handler(event):
+            if isinstance(event, ev.EndPass):
+                print(f"pass {event.pass_id}: {event.metrics}")
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(
+                truncate(paddle.dataset.imdb.train(word_dict)), 2048,
+                seed=0), 16, drop_last=True)
+        trainer.train(reader, num_passes=3, event_handler=handler,
+                      feeding={"word": 0, "label": 1})
+        metrics = trainer.test(
+            paddle.reader.batch(truncate(paddle.dataset.imdb.test(
+                word_dict)), 16, drop_last=True),
+            feeding={"word": 0, "label": 1},
+            evaluators=[paddle.evaluator.classification_error()])
+        print("test:", metrics)
+        return 0 if metrics["classification_error"] < 0.35 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,38 @@ from ..data.feeder import integer_value, integer_value_sequence
 from ..v2.networks import simple_lstm
 
 
+def transformer_classifier_cost(vocab_size: int, model_dim: int = 128,
+                                num_heads: int = 4, num_layers: int = 2,
+                                ffn_dim: int = 512, num_classes: int = 2,
+                                max_len: int = 2048,
+                                causal: bool = False,
+                                data_name: str = "data"):
+    """Build the transformer classifier cost INSIDE an open
+    ``config_scope`` — shared by :func:`transformer_text_classifier`
+    and ``demo/transformer/train.py`` so model zoo and demo can't
+    drift."""
+    net = dsl.data(data_name, integer_value_sequence(vocab_size))
+    net = dsl.embedding(net, size=model_dim)
+    net = dsl.position_embedding(net, max_len=max_len)
+    for i in range(num_layers):
+        att = dsl.scaled_dot_product_attention(
+            dsl.layer_norm(net, name=f"ln{i}a"), size=model_dim,
+            num_heads=num_heads, causal=causal, name=f"attn{i}",
+            bias_attr=True)
+        net = dsl.addto([net, att], name=f"res{i}a")
+        ffn = dsl.fc(dsl.layer_norm(net, name=f"ln{i}f"),
+                     size=ffn_dim, act=dsl.Activation("relu"),
+                     name=f"ffn{i}_in")
+        ffn = dsl.fc(ffn, size=model_dim, name=f"ffn{i}_out")
+        net = dsl.addto([net, ffn], name=f"res{i}f")
+    net = dsl.layer_norm(net, name="ln_final")
+    net = dsl.pooling_layer(net, pooling_type=dsl.AvgPooling())
+    net = dsl.fc(net, size=num_classes,
+                 act=dsl.Activation("softmax"), name="cls")
+    lab = dsl.data("label", integer_value(num_classes))
+    return dsl.classification_cost(net, lab)
+
+
 def transformer_text_classifier(vocab_size: int = 30000,
                                 model_dim: int = 128, num_heads: int = 4,
                                 num_layers: int = 2, ffn_dim: int = 512,
@@ -28,27 +60,9 @@ def transformer_text_classifier(vocab_size: int = 30000,
     surface, the way the reference's RNN benchmark fronts ``hl_lstm``.
     """
     with dsl.config_scope():
-        net = dsl.data("data", integer_value_sequence(vocab_size))
-        net = dsl.embedding(net, size=model_dim)
-        net = dsl.position_embedding(net, max_len=max_len)
-        for i in range(num_layers):
-            att = dsl.scaled_dot_product_attention(
-                dsl.layer_norm(net, name=f"ln{i}a"), size=model_dim,
-                num_heads=num_heads, causal=causal, name=f"attn{i}",
-                bias_attr=True)
-            net = dsl.addto([net, att], name=f"res{i}a")
-            ffn = dsl.fc(dsl.layer_norm(net, name=f"ln{i}f"),
-                         size=ffn_dim, act=dsl.Activation("relu"),
-                         name=f"ffn{i}_in")
-            ffn = dsl.fc(ffn, size=model_dim, name=f"ffn{i}_out")
-            net = dsl.addto([net, ffn], name=f"res{i}f")
-        net = dsl.layer_norm(net, name="ln_final")
-        net = dsl.pooling_layer(net, pooling_type=dsl.AvgPooling())
-        net = dsl.fc(net, size=num_classes,
-                     act=dsl.Activation("softmax"), name="cls")
-        lab = dsl.data("label", integer_value(num_classes))
-        cost = dsl.classification_cost(net, lab)
-        return dsl.topology(cost)
+        return dsl.topology(transformer_classifier_cost(
+            vocab_size, model_dim, num_heads, num_layers, ffn_dim,
+            num_classes, max_len, causal))
 
 
 def lstm_text_classifier(vocab_size: int = 30000, embed_dim: int = 128,

@@ -142,6 +142,36 @@ def test_position_embedding_adds_table_slice():
                                rtol=1e-6, atol=1e-6)
 
 
+def test_transformer_demo_topology_trains_one_batch():
+    """The demo's own builder (demo/transformer/train.py) — imported, so
+    demo and test can't drift — must build and take a training step."""
+    import importlib.util
+    import os
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.trainer import Trainer
+
+    demo_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "..", "demo", "transformer", "train.py")
+    spec = importlib.util.spec_from_file_location("transformer_demo",
+                                                  demo_path)
+    demo = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(demo)
+
+    with dsl.config_scope():
+        cost = demo.build_classifier(vocab_size=30)
+        topo = dsl.topology(cost)
+    net = NeuralNetwork(topo)
+    trainer = Trainer(net, Adam(learning_rate=1e-3))
+    rng = np.random.RandomState(3)
+    feed = {"word": pad_batch([rng.randint(0, 30, (l,))
+                               for l in (7, 4)]),
+            "label": jnp.asarray([0, 1], jnp.int32)}
+    loss = float(trainer.train_one_batch(feed))
+    assert np.isfinite(loss)
+
+
 def test_transformer_classifier_converges():
     """End-to-end: the DSL-built transformer (embedding → pos →
     flash-attention blocks → pool → softmax) separates a toy task where
