@@ -121,8 +121,12 @@ def export_network(network, params: Dict[str, jax.Array],
 
     ``output_names`` defaults to the network's declared outputs (cost
     layers replaced by their prediction input, as ``v2.infer`` does).
+
+    :class:`SequenceBatch` feeds are flattened into TWO artifact feeds —
+    ``<name>`` (padded data) and ``<name>_len`` (int32 lengths) — so the
+    standalone loader's plain-array contract covers sequence models.
     """
-    from ..core.sequence import value_of
+    from ..core.sequence import SequenceBatch, value_of
 
     if output_names is None:
         output_names = []
@@ -136,10 +140,26 @@ def export_network(network, params: Dict[str, jax.Array],
     enforce(output_names, "export_network: no output names")
     bufs = buffers if buffers is not None else network.init_buffers()
 
+    seq_feeds = {k for k, v in example_feed.items()
+                 if isinstance(v, SequenceBatch)}
+    for k in seq_feeds:
+        enforce(k + "_len" not in example_feed,
+                f"export_network: feed {k + '_len'!r} collides with the "
+                f"flattened lengths of sequence feed {k!r}")
+    flat_examples: Dict[str, Any] = {}
+    for k, v in example_feed.items():
+        if k in seq_feeds:
+            flat_examples[k] = np.asarray(v.data)
+            flat_examples[k + "_len"] = np.asarray(v.length)
+        else:
+            flat_examples[k] = v
+
     def fn(feed):
-        values, _ = network.forward(params, feed, bufs, is_training=False,
-                                    only=output_names)
+        rebuilt = {k: SequenceBatch(feed[k], feed[k + "_len"])
+                   if k in seq_feeds else feed[k] for k in example_feed}
+        values, _ = network.forward(params, rebuilt, bufs,
+                                    is_training=False, only=output_names)
         return {n: value_of(values[n]) for n in output_names}
 
-    return export_inference_fn(fn, example_feed, dirname, output_names,
+    return export_inference_fn(fn, flat_examples, dirname, output_names,
                                batch_polymorphic=batch_polymorphic)

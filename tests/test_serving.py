@@ -136,3 +136,35 @@ def test_loader_rejects_bad_feed_and_future_version(tmp_path):
     json.dump(manifest, open(mpath, "w"))
     with pytest.raises(ValueError):
         ServedModel.load(d)
+
+
+def test_export_transformer_with_flash_attention(tmp_path):
+    """The round-5 attention layers survive the serving export: a
+    transformer classifier (Pallas flash attention inside) exports to a
+    StableHLO artifact and the loader reproduces the framework's
+    probabilities on a fixed-shape batch."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models.text import transformer_classifier_cost
+    from paddle_tpu.serving import ServedModel, export_network
+
+    with config_scope():
+        cfg = dsl.topology(transformer_classifier_cost(
+            vocab_size=20, model_dim=16, num_heads=2, num_layers=1,
+            ffn_dim=32, max_len=16))
+    net = NeuralNetwork(cfg)
+    params = net.init_params(7)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 20, (4, 8)).astype(np.int32)
+    lens = np.array([8, 5, 8, 3], np.int32)
+    feed = {"data": SequenceBatch(ids, lens)}
+
+    d = str(tmp_path / "artifact")
+    export_network(net, params, feed, d)
+
+    vals, _ = net.forward(params, feed, net.init_buffers(),
+                          is_training=False, only=["cls"])
+    ref = np.asarray(value_of(vals["cls"]))
+
+    m = ServedModel.load(d)
+    got = m(data=ids, data_len=lens)["cls"]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
