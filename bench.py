@@ -1,6 +1,6 @@
 """Headline benchmarks, matched to BASELINE.json's primary metrics.
 
-Four workloads (the first printed line is the driver-parsed metric):
+Five workloads (the first printed line is the driver-parsed metric):
 
 1. **LSTM text classifier** training ms/batch — the reference RNN
    benchmark (``benchmark/paddle/rnn/rnn.py`` via ``paddle train
@@ -19,6 +19,11 @@ Four workloads (the first printed line is the driver-parsed metric):
 4. **transformer** training tokens/sec at T=2048 — the flash-attention
    kernel's product surface (``scaled_dot_product_attention`` layer);
    no reference yardstick exists (2017 codebase), MFU is the figure.
+5. **LSTM hidden=1280** ms/batch — the baseline's big-hidden row
+   (1007 ms on K40m, ``benchmark/README.md:124-126``).  H=1280 exceeds
+   the fused Pallas LSTM's VMEM gate (``ops/pallas_lstm.py``) and runs
+   the ``lax.scan`` path, so this row MEASURES the fallback gap the
+   gate used to hide (VERDICT missing #5).
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
 Adam).  Timing chains K steps inside one ``lax.scan`` program (see
@@ -26,6 +31,14 @@ Adam).  Timing chains K steps inside one ``lax.scan`` program (see
 the same order as a small step; ``timing_self_check`` is the relative
 spread of the warm K-step samples.  MFU is an exact-MAC FLOP count over
 an assumed 197 TFLOP/s bf16 peak (v5e).
+
+Every emitted json line carries the **run-mode band**: ``attempts``
+(the per-attempt metric values — one entry for single-shot workloads),
+``median`` and ``spread`` ((max−min)/min across attempts), and for the
+resnet workload the per-attempt MFUs with a fast/slow ``modes`` count
+(threshold 0.35, the PERF_NOTES bimodality).  A best-of number alone
+hid the ResNet slow-mode miss in round 5; the band keeps the
+bimodality visible in the artifact.
 """
 
 import argparse
@@ -114,6 +127,22 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
     return max(ms, 1e-3), spread
 
 
+def _with_band(r, values=None, mfus=None, fast_mfu=0.35):
+    """Attach the run-mode band fields to a result dict: per-attempt
+    values, median, relative spread, and (when per-attempt MFUs are
+    known) the fast/slow mode census.  Single-shot workloads report a
+    one-entry band — honest about having sampled one process mode."""
+    vals = [r["value"]] if values is None else list(values)
+    r["attempts"] = [round(float(v), 3) for v in vals]
+    r["median"] = round(float(np.median(vals)), 3)
+    r["spread"] = round((max(vals) - min(vals)) / max(min(vals), 1e-9), 3)
+    if mfus is not None:
+        r["attempt_mfus"] = [round(float(m), 3) for m in mfus]
+        r["modes"] = {"fast": int(sum(m >= fast_mfu for m in mfus)),
+                      "slow": int(sum(m < fast_mfu for m in mfus))}
+    return r
+
+
 def _mk_trainer(cfg, lr=2e-3, clip=25.0, l2=0.0, mesh=None):
     from paddle_tpu.config.model_config import OptimizationConfig
     from paddle_tpu.layers.network import NeuralNetwork
@@ -130,7 +159,9 @@ def _n_chips(trainer):
     return int(mesh.devices.size) if mesh is not None else 1
 
 
-def bench_lstm():
+def _bench_lstm_row(hidden, baseline_ms, metric, iters=256):
+    """One LSTM text-classifier row (bs=128, 2×LSTM, T=100) at the given
+    hidden size against the matching K40m baseline (BASELINE.md:18)."""
     # AMP-style mixed precision (--bf16_activations): activations stored
     # bf16, params/losses fp32 — measured 5.68 → 5.35 ms/batch here.
     # (seq2seq keeps it off: the attention group path measured slower.)
@@ -139,7 +170,7 @@ def bench_lstm():
     from paddle_tpu.core.sequence import SequenceBatch
     from paddle_tpu.models import lstm_text_classifier
 
-    B, T, H, V, E = 128, 100, 512, 30000, 128
+    B, T, H, V, E = 128, 100, hidden, 30000, 128
     devices = jax.devices()
     mesh = build_mesh({"data": len(devices)}, devices)
     set_mesh(mesh)
@@ -154,21 +185,36 @@ def bench_lstm():
                     rng.randint(T // 2, T + 1, (B,)).astype(np.int32))),
             "label": jax.numpy.asarray(rng.randint(0, 2, (B,)).astype(np.int32))}
 
-    ms, agree = _scan_time_ms(trainer, feed)
+    ms, agree = _scan_time_ms(trainer, feed, iters=iters)
     n = _n_chips(trainer)
     # fwd matmul FLOPs: layer1 x-proj [B,E]→[B,4H] + h-proj [B,H]→[B,4H],
     # layer2 both projections from H; per timestep, ×T
     fwd = 2 * B * T * (E * 4 * H + H * 4 * H + H * 4 * H + H * 4 * H)
     mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
-    return {
-        "metric": "lstm_text_cls_ms_per_batch",
+    return _with_band({
+        "metric": metric,
         "value": round(ms, 3),
-        "unit": "ms/batch (bs=128, hidden=512, 2xLSTM, T=100)",
-        "vs_baseline": round(261.0 / ms, 3),   # K40m bs=128 hid=512 row
+        "unit": f"ms/batch (bs=128, hidden={H}, 2xLSTM, T=100)",
+        "vs_baseline": round(baseline_ms / ms, 3),
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
-    }
+    })
+
+
+def bench_lstm():
+    return _bench_lstm_row(512, 261.0, "lstm_text_cls_ms_per_batch")
+
+
+def bench_lstm_1280():
+    """The baseline's hidden=1280/bs=128 row (1007 ms on K40m).  H=1280
+    is past the fused kernel's VMEM gate → lax.scan path (with the
+    one-time fallback warning from ops/recurrent_ops.py), so this row
+    measures the un-fused gap instead of silently hiding it."""
+    r = _bench_lstm_row(1280, 1007.0, "lstm_text_cls_1280_ms_per_batch",
+                        iters=64)
+    r["note"] = "H=1280 > fused-LSTM VMEM gate; measures the scan path"
+    return r
 
 
 def _bench_resnet_once():
@@ -213,31 +259,36 @@ def _bench_resnet_once():
 
 
 def bench_resnet():
-    """Best of up to 5 fresh compiles.  Repeated runs are bimodal
+    """Up to 5 fresh compiles; the headline is still the best attempt
+    but EVERY attempt lands in the artifact.  Repeated runs are bimodal
     (~2700 vs ~3000 samples/s with per-run self-checks ≤0.015): the
     per-PROCESS compile/chip state, not step-timing noise, decides which
     mode a run lands in — this is the round-4 driver-2702 vs
-    builder-2908 gap.  Each attempt rebuilds the trainer after
+    builder-2908 gap, and a bare best-of number hid the slow-mode MFU
+    miss in round 5.  The band fields (attempts / median / spread /
+    per-attempt MFUs / fast-slow mode census) keep the bimodality
+    visible.  Each attempt rebuilds the trainer after
     jax.clear_caches(); attempts stop early once the 0.35-MFU target is
     met, and the attempt count is reported.  (One attempt ≈ 2–3.5 min;
     the elapsed-time guard below keeps the workload under ~9-10 min
     worst case.)"""
-    best = None
+    results = []
     t0 = time.perf_counter()
     for attempt in range(5):
-        r = _bench_resnet_once()
-        if best is None or r["value"] > best["value"]:
-            best = r
+        results.append(_bench_resnet_once())
         # stop early on target met, or when another ~2-3.5 min attempt
         # would push the workload past ~12-13 minutes total.  Five
         # attempts: the slow mode clusters in time (shared-chip
         # contention), so P(all slow) shrinks fast with retries while
         # early-stop keeps the common case at one or two attempts.
-        if best["mfu_est"] >= 0.35 or time.perf_counter() - t0 > 10 * 60:
+        if max(r["mfu_est"] for r in results) >= 0.35 \
+                or time.perf_counter() - t0 > 10 * 60:
             break
         jax.clear_caches()
-    best["best_of_attempts"] = attempt + 1
-    return best
+    best = dict(max(results, key=lambda r: r["value"]))
+    best["best_of_attempts"] = len(results)
+    return _with_band(best, [r["value"] for r in results],
+                      [r["mfu_est"] for r in results])
 
 
 def seq2seq_setup(B=128, S_LEN=30, T_LEN=30, V=30000, E=512, H=512,
@@ -318,7 +369,7 @@ def bench_seq2seq():
     dec = 2 * B * T_LEN * ((2 * H + E) * 3 * H + H * 3 * H + H * V)
     mfu = TRAIN_FLOP_FACTOR * (enc + dec) / (ms / 1e3) / \
         (PEAK_FLOPS_BF16 * n)
-    return {
+    return _with_band({
         "metric": "seq2seq_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
         "unit": f"target tokens/sec (bs={B}, src=trg=30, hid=512, attn)",
@@ -330,7 +381,7 @@ def bench_seq2seq():
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
-    }
+    })
 
 
 def bench_attention():
@@ -365,7 +416,7 @@ def bench_attention():
     # out-proj B·T·D·D + ffn B·T·2·D·F; embedding/head negligible
     fwd = 2 * L * B * T * (3 * D * D + 2 * T * D + D * D + 2 * D * F)
     mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
-    return {
+    return _with_band({
         "metric": "transformer_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
         "unit": f"tokens/sec (bs={B}, T={T}, d={D}, {L}L/{HEADS}H, "
@@ -375,7 +426,7 @@ def bench_attention():
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
-    }
+    })
 
 
 def main():
@@ -391,12 +442,14 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
-                    choices=["lstm", "resnet", "seq2seq", "attention"])
+                    choices=["lstm", "resnet", "seq2seq", "attention",
+                             "lstm1280"])
     args = ap.parse_args()
     benches = {"lstm": bench_lstm, "resnet": bench_resnet,
-               "seq2seq": bench_seq2seq, "attention": bench_attention}
+               "seq2seq": bench_seq2seq, "attention": bench_attention,
+               "lstm1280": bench_lstm_1280}
     order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
-                                           "attention"]
+                                           "attention", "lstm1280"]
     for name in order:
         try:
             print(json.dumps(benches[name]()), flush=True)

@@ -80,15 +80,22 @@ class ConvLayer(_ImgLayer):
             specs.append(self._bias_spec((nf,)))
         return specs
 
-    def forward(self, params, inputs, ctx):
-        c, f, fy, nf, groups = self._shapes()
+    def geometry(self):
+        """(channels, (h, w) img size, (sy, sx) stride, (py, px) pad,
+        groups) — shared by :meth:`forward` and the fused conv→BN path
+        in :class:`BatchNormLayer`."""
+        c = self.geo("channels")
         h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
         w = self.geo("img_size")
-        x = to_nhwc(value_of(inputs[0]), c, h, w)
         stride = (self.conf.attrs.get("stride_y", self.conf.attrs.get("stride", 1)),
                   self.conf.attrs.get("stride", 1))
         pad = (self.conf.attrs.get("padding_y", self.conf.attrs.get("padding", 0)),
                self.conf.attrs.get("padding", 0))
+        return c, (h, w), stride, pad, self.conf.attrs.get("groups", 1)
+
+    def forward(self, params, inputs, ctx):
+        c, (h, w), stride, pad, groups = self.geometry()
+        x = to_nhwc(value_of(inputs[0]), c, h, w)
         out = nn_ops.conv2d(x, params[self.weight_name(0)], stride=stride,
                             padding=[(pad[0], pad[0]), (pad[1], pad[1])],
                             groups=groups)
@@ -206,6 +213,41 @@ class BatchNormLayer(_ImgLayer):
         ctx.new_buffers[self.name + ".mean"] = nrm
         ctx.new_buffers[self.name + ".var"] = nrv
         return self.finalize(like(inputs[0], y), ctx)
+
+    def _bn_args(self, params):
+        """(scale, bias, momentum) shared by both forward paths."""
+        c = self.conf.attrs.get("channels", self.conf.size)
+        bias = params.get(self.bias_name())
+        if bias is None:
+            bias = jnp.zeros((c,), jnp.float32)
+        return params[self.weight_name(0)], bias, \
+            self.conf.attrs.get("moving_average_fraction", 0.9)
+
+    def forward_fused(self, params, conv, conv_inputs, ctx):
+        """Execute the fused conv→BN pair (network peephole): ``conv``
+        is the producing :class:`ConvLayer`, ``conv_inputs`` its inputs.
+        Semantics are exactly conv-forward (linear act, gated) followed
+        by :meth:`forward`; ``nn_ops.conv2d_bn`` dispatches the Pallas
+        fused-backward path when the shapes tile and falls back to the
+        identical unfused composition otherwise (and in eval mode)."""
+        c, (h, w), stride, pad, groups = conv.geometry()
+        x = to_nhwc(value_of(conv_inputs[0]), c, h, w)
+        cw = params[conv.weight_name(0)]
+        cb = params.get(conv.bias_name()) if conv.conf.with_bias else None
+        scale, bias, momentum = self._bn_args(params)
+        rm = ctx.buffers.get(self.name + ".mean",
+                             jnp.zeros((cw.shape[3],), jnp.float32))
+        rv = ctx.buffers.get(self.name + ".var",
+                             jnp.ones((cw.shape[3],), jnp.float32))
+        use_global = self.conf.attrs.get("use_global_stats", None)
+        training = ctx.is_training if use_global is None else not use_global
+        y, nrm, nrv = nn_ops.conv2d_bn(
+            x, cw, cb, scale, bias, rm, rv, momentum=momentum,
+            is_training=training, stride=stride,
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])], groups=groups)
+        ctx.new_buffers[self.name + ".mean"] = nrm
+        ctx.new_buffers[self.name + ".var"] = nrv
+        return self.finalize(like(conv_inputs[0], y), ctx)
 
 
 @register_layer("maxout")

@@ -114,6 +114,69 @@ class NeuralNetwork:
                     and pconf.error_clipping_threshold == 0:
                 self._cost_logit_alias[cname] = pname + ".logits"
 
+        # conv→BN fusion peephole: a batch-norm whose sole producer is a
+        # linear 3×3 stride-1 pad-1 conv consumed by nothing else routes
+        # through the fused conv+BN op (ops/nn_ops.py::conv2d_bn — the
+        # Pallas backward-data kernel with the BN-backward affine folded
+        # into its input pipeline).  Mirrors the logits peephole above:
+        # pattern-matched once at build time on the static config; the
+        # op itself re-gates on shapes/dtype and falls back to the exact
+        # unfused composition, so firing is always semantics-preserving.
+        from ..utils import FLAGS
+
+        self._conv_bn_fuse: Dict[str, str] = {}
+        conv_types = ("exconv", "cudnn_conv", "conv", "mkldnn_conv")
+        if not FLAGS.get("conv_bn_fuse"):
+            conv_types = ()    # A/B kill switch (--conv_bn_fuse=false)
+        bn_types = ("batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm")
+        n_consumers: Dict[str, int] = {}
+        for lc in config.layers:
+            for iname in lc.input_names():
+                n_consumers[iname] = n_consumers.get(iname, 0) + 1
+        # consumers that read values by name OUTSIDE layer input lists:
+        # group in/out links, memory boot layers, generator static
+        # inputs, and evaluator inputs — a conv referenced by any of
+        # these must keep its standalone value
+        extra_consumers: Set[str] = set()
+        for sm in config.sub_models:
+            if sm.name == "root":
+                continue
+            extra_consumers.update(sm.in_links)
+            extra_consumers.update(sm.out_links)
+            for m in sm.memories:
+                if m.get("boot_layer_name"):
+                    extra_consumers.add(m["boot_layer_name"])
+            extra_consumers.update(sm.generator.get("static_inputs", ()))
+        for ev in config.evaluators:
+            for key in ("input_layer_name", "label_layer_name"):
+                if ev.get(key):
+                    extra_consumers.add(ev[key])
+        outputs = set(self.output_names) | extra_consumers
+        for lconf in config.layers:
+            if lconf.type not in bn_types or len(lconf.inputs) != 1 \
+                    or lconf.name not in self.layers:
+                continue
+            pname = lconf.inputs[0].input_layer_name
+            pconf = lmap.get(pname)
+            if pconf is None or pconf.type not in conv_types \
+                    or pname not in self.layers:
+                continue
+            a = pconf.attrs
+            f = a.get("filter_size")
+            s = a.get("stride", 1)
+            p = a.get("padding", 0)
+            if (f == 3 and a.get("filter_size_y", f) == 3
+                    and s == 1 and a.get("stride_y", s) == 1
+                    and p == 1 and a.get("padding_y", p) == 1
+                    and a.get("groups", 1) == 1
+                    and len(pconf.inputs) == 1
+                    and pconf.active_type in ("", "linear")
+                    and pconf.drop_rate == 0
+                    and pconf.error_clipping_threshold == 0
+                    and n_consumers.get(pname, 0) == 1
+                    and pname not in outputs):
+                self._conv_bn_fuse[lconf.name] = pname
+
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:
             for spec in layer.param_specs():
@@ -220,9 +283,18 @@ class NeuralNetwork:
         values: Dict[str, Any] = {}
         done_groups: Set[str] = set()
         needed = self._ancestors(only) if only is not None else None
+        # conv→BN pairs active for THIS call: the conv is skipped and the
+        # BN executes the fused op — unless the conv's own value was
+        # explicitly requested (it then must exist standalone)
+        targets = set(only) if only is not None else set()
+        fuse = {bn: cv for bn, cv in self._conv_bn_fuse.items()
+                if (needed is None or bn in needed) and cv not in targets}
+        fused_convs = set(fuse.values())
         for name in self.order:
             if needed is not None and name not in needed:
                 continue
+            if name in fused_convs:
+                continue  # produced inside its batch-norm partner
             layer = self.layers[name]
             if layer.conf.type == "data":
                 if name not in feed:
@@ -232,17 +304,27 @@ class NeuralNetwork:
             # run any recurrent group whose inputs are all ready lazily:
             # groups appear in order via their output layers
             with layer_stack.guard(name):
-                inputs = []
-                for iname in layer.conf.input_names():
-                    if iname not in values:
-                        self._run_producer(iname, params, values, ctx, done_groups)
-                    inputs.append(values[iname])
-                if name in self._cost_logit_alias:
-                    # hand the cost its producer's logits when the graph
-                    # exposed them (None → cost falls back to probs)
-                    layer._logits_value = values.get(
-                        self._cost_logit_alias[name])
-                out = cast_layer_output(layer, layer.forward(params, inputs, ctx))
+                src = fuse.get(name)
+                if src is not None:
+                    conv = self.layers[src]
+                    cinputs = self._gather(conv.conf.input_names(),
+                                           params, values, ctx,
+                                           done_groups)
+                    out = cast_layer_output(
+                        layer, layer.forward_fused(params, conv,
+                                                   cinputs, ctx))
+                else:
+                    inputs = self._gather(layer.conf.input_names(),
+                                          params, values, ctx,
+                                          done_groups)
+                    if name in self._cost_logit_alias:
+                        # hand the cost its producer's logits when the
+                        # graph exposed them (None → cost falls back to
+                        # probs)
+                        layer._logits_value = values.get(
+                            self._cost_logit_alias[name])
+                    out = cast_layer_output(
+                        layer, layer.forward(params, inputs, ctx))
             if isinstance(out, dict):
                 for k, v in out.items():
                     values[name if k == "out" else f"{name}.{k}"] = v
@@ -261,6 +343,15 @@ class NeuralNetwork:
                 self._run_producer(name, params, values, ctx, done_groups)
         ctx.buffers.update(ctx.new_buffers)
         return values, ctx.buffers
+
+    def _gather(self, names, params, values, ctx, done_groups):
+        """Resolve input values, running lazy group producers on demand."""
+        vals = []
+        for iname in names:
+            if iname not in values:
+                self._run_producer(iname, params, values, ctx, done_groups)
+            vals.append(values[iname])
+        return vals
 
     def _run_producer(self, name: str, params, values, ctx, done_groups):
         """Produce a value coming from a recurrent-group output link."""

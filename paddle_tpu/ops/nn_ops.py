@@ -393,6 +393,55 @@ def batch_norm(x, scale, bias, running_mean, running_var,
     return y, running_mean, running_var
 
 
+@register_op("conv2d_bn", n_outputs=3)
+def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
+              momentum: float = 0.9, eps: float = 1e-5,
+              is_training: bool = True, stride: IntOr2 = 1,
+              padding="SAME", dilation: IntOr2 = 1, groups: int = 1,
+              data_format: str = "NHWC"):
+    """Fused conv + batch-norm (training): same contract as
+    ``conv2d`` (+ optional conv bias) followed by ``batch_norm``, but
+    for the 3×3 stride-1 NHWC family the backward runs through the
+    Pallas backward-data kernel in :mod:`paddle_tpu.ops.pallas_conv`,
+    which applies the BN-backward per-channel affine while streaming
+    tiles through VMEM — the dz apply pass and its HBM round-trip
+    disappear (the cuDNN fused conv/BN backward of
+    ``hl_cuda_cudnn.cc``, rebuilt for TPU).  Shapes outside the fused
+    family, eval mode, and non-NHWC layouts take the exact unfused
+    composition — same results either way, pinned by
+    ``tests/test_pallas_conv.py``.
+
+    Returns (y, new_running_mean, new_running_var) like ``batch_norm``.
+    """
+    from . import pallas_conv
+
+    pol = current_policy()
+    if not (is_training and pallas_conv.fusable(
+            jnp.shape(x), jnp.shape(w), stride, padding, dilation,
+            groups, data_format)):
+        z = conv2d(x, w, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+        if conv_bias is not None:
+            z = z + conv_bias
+        return batch_norm(z, scale, bias, running_mean, running_var,
+                          momentum=momentum, eps=eps,
+                          is_training=is_training,
+                          data_format=data_format)
+    xc = x.astype(pol.compute_dtype)
+    wc = w.astype(pol.compute_dtype)
+    cb = jnp.zeros((wc.shape[3],), jnp.float32) if conv_bias is None \
+        else conv_bias
+    y = pallas_conv._conv_bn_core(xc, wc, cb, scale, bias, eps)
+    # stats recomputed outside the custom_vjp for the running averages
+    # (XLA CSEs the conv and reductions with the ones inside the core)
+    z = pallas_conv._conv3x3(xc, wc) + cb.astype(xc.dtype)
+    m, v = _bn_stats(z, (0, 1, 2))
+    new_rm = momentum * running_mean + (1 - momentum) * m
+    new_rv = momentum * running_var + (1 - momentum) * v
+    return y.astype(pol.output_dtype), new_rm, new_rv
+
+
 @register_op("lrn")
 def lrn(x, n: int = 5, k: float = 2.0, alpha: float = 1e-4, beta: float = 0.75):
     """Local response normalization across channels, NHWC

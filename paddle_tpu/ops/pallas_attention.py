@@ -35,6 +35,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# jax renamed TPUCompilerParams → CompilerParams (0.5.x); resolve once
+# here so every Pallas module runs interpret-mode CI on either version.
+CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 def _choose_block(t: int, want: int) -> int:
     b = min(want, t)
@@ -197,7 +202,7 @@ def _fa_forward(q, k, v, lengths, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 8, tq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(lengths.astype(jnp.int32), qh, kh, vh)
@@ -319,7 +324,7 @@ def _fa_backward_pallas(q, k, v, lengths, out, lse, do, causal, bq, bk):
     lengths = lengths.astype(jnp.int32)
 
     common = dict(
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )

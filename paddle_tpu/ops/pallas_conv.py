@@ -1,0 +1,257 @@
+"""Fused conv backward-data + BatchNorm-affine as a Pallas TPU kernel.
+
+The ResNet-class train step is HBM-bound, not MXU-bound (PERF_NOTES:
+27 GB/step, bandwidth util ~0.70 while flops util sits at 0.29).  The
+largest removable slice of that traffic is the seam between the
+BatchNorm backward and the conv backward that consumes its result: XLA
+cannot fuse an elementwise producer into a convolution operand (convs
+read their inputs from HBM), so the BN backward's apply pass
+
+    dz = scale·inv · (dy − Σdy/N − x̂ · Σ(dy·x̂)/N)
+
+materializes ``dz`` in HBM only for the conv backward-data and
+backward-filter kernels to immediately re-read it.  The reference hit
+the same wall on GPUs and solved it with fused cuDNN conv/BN entry
+points (``hl_cuda_cudnn.cc`` / ``CudnnBatchNormLayer.cpp``); the TPU
+analogue of that tier is this module.
+
+Key identity: with A = scale·inv, B = −A·inv·Σ(dy·x̂)/N and
+C = A·(inv·m·Σ(dy·x̂) − Σdy)/N (all per-channel scalars computed by one
+reduction pass), the BN backward is the **per-channel affine**
+
+    dz = A·dy + B·z + C
+
+of two tensors already resident in HBM (the upstream cotangent dy and
+the conv output z, which is saved for the BN backward anyway).  The
+Pallas backward-data kernel below streams (dy, z) tiles through VMEM,
+forms dz on-chip, and immediately runs the 3×3 backward-data matmuls on
+it — writing dx *and* dz in the same pass so the filter-grad conv that
+still runs under XLA reads a ready-made dz.  Per fused conv→BN pair
+this removes one full read+write of an activation-sized tensor from the
+step (the apply pass's dz store and the backward-data conv's dz load),
+which is exactly the traffic class PERF_NOTES identified as the
+roofline.
+
+Kernel shape: grid = (N,) with one image per step ("arbitrary"
+semantics, pallas double-buffers the streaming blocks).  The 3×3
+stride-1 backward-data conv is decomposed into 9 shifted [H·W, Cout] @
+[Cout, Cin] MXU matmuls over a zero-padded VMEM scratch tile — no halo
+exchange, no [T, T]-style intermediate, one HBM read of dy and z and
+one write of dx and dz.  The spatially-flipped, I/O-transposed weight
+``wT[a, b] = w[2−a, 2−b].T`` stays resident in VMEM (≤ 9.4 MB f32 at
+C=512, inside the 16 MB budget with the stage-4 7×7 tiles).
+
+Shapes that don't tile (channels not a multiple of 64, VMEM overflow)
+dispatch to the plain ``conv2d`` + ``batch_norm`` composition in
+:mod:`paddle_tpu.ops.nn_ops` — same contract, same results.  On
+non-TPU backends the kernel runs in Pallas interpret mode so CPU tests
+exercise the exact dispatch used on hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial as _partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_attention import CompilerParams, _interpret  # shared gate
+
+# VMEM budget for the gate: tiles + resident weights must fit under the
+# 16 MB scoped-vmem cap with headroom for double-buffering.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def fused_ok(h: int, w: int, cin: int, cout: int) -> bool:
+    """Mosaic tiling gate, checked on every backend so interpret-mode
+    tests exercise the hardware dispatch.  Channels must land on the
+    128-lane minor dimension in at most two tiles (multiples of 64 —
+    covers ResNet-50's 3×3 family: 64/128/256/512); the per-image tile
+    set (dy, z, dz f32, padded-dz scratch, dx accumulator) plus the
+    resident flipped weight must fit the VMEM budget."""
+    if cin % 64 or cout % 64 or h < 1 or w < 1:
+        return False
+    f32 = 4
+    tile = h * w * (4 * cout + cin) * f32 \
+        + (h + 2) * (w + 2) * cout * f32
+    return tile + 9 * cout * cin * f32 <= _VMEM_BUDGET
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def fusable(x_shape, w_shape, stride, padding, dilation, groups,
+            data_format) -> bool:
+    """Full static dispatch gate for the fused conv→BN path: the 3×3
+    stride-1 SAME/pad-1 grouped-less NHWC family whose shapes tile."""
+    if data_format != "NHWC" or groups != 1:
+        return False
+    if len(x_shape) != 4 or len(w_shape) != 4:
+        return False
+    if tuple(w_shape[:2]) != (3, 3):
+        return False
+    if _pair(stride) != (1, 1) or _pair(dilation) != (1, 1):
+        return False
+    if isinstance(padding, str):
+        if padding != "SAME":
+            return False
+    else:
+        pads = [_pair(p) for p in padding] if not isinstance(padding, int) \
+            else [(padding, padding)] * 2
+        if pads != [(1, 1), (1, 1)]:
+            return False
+    n, h, w_, _cin = x_shape
+    return fused_ok(h, w_, int(w_shape[2]), int(w_shape[3]))
+
+
+def _conv3x3(x, w):
+    """The forward this module's backward belongs to: 3×3 stride-1
+    pad-1 NHWC/HWIO conv, stated exactly as ``nn_ops.conv2d`` lowers it
+    so the fused op's forward is bit-identical to the unfused path."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+        dimension_numbers=dn)
+
+
+# ------------------------------------------------------------- dX kernel
+def _dx_kernel(g_ref, z_ref, co_ref, wt_ref, dx_ref, dz_ref, pad_s, *,
+               hh, ww):
+    """One image per grid step: form dz = A·dy + B·z + C in VMEM, write
+    it out for the filter-grad conv, then accumulate the 9 shifted
+    matmuls of the 3×3 backward-data conv from the zero-padded scratch.
+    All compute in f32 (the affine coefficients mix magnitudes; the MXU
+    accumulates f32 natively)."""
+    g = g_ref[0].astype(jnp.float32)                 # [H, W, Cout]
+    z = z_ref[0].astype(jnp.float32)
+    co = co_ref[...].astype(jnp.float32)             # [8, Cout]
+    dz = co[0] * g + co[1] * z + co[2]               # per-channel affine
+    dz_ref[0] = dz.astype(dz_ref.dtype)
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _zero_borders():
+        # interior is overwritten every step; borders must read as the
+        # implicit SAME zero-padding and only need zeroing once
+        pad_s[...] = jnp.zeros_like(pad_s)
+
+    pad_s[1:hh + 1, 1:ww + 1, :] = dz
+    wt = wt_ref[...].astype(jnp.float32)             # [3, 3, Cout, Cin]
+    cin = wt.shape[-1]
+    acc = jnp.zeros((hh * ww, cin), jnp.float32)
+    for a in range(3):
+        for b in range(3):
+            sl = pad_s[a:a + hh, b:b + ww, :].reshape(hh * ww, -1)
+            acc = acc + jax.lax.dot_general(
+                sl, wt[a, b], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    dx_ref[0] = acc.reshape(hh, ww, cin).astype(dx_ref.dtype)
+
+
+def _dx_call(dy, z, coeffs, w, dx_dtype, dz_dtype):
+    """dy, z: [N, H, W, Cout]; coeffs: [8, Cout] f32 (rows 0..2 =
+    A/B/C, rest zero); w: [3, 3, Cin, Cout] forward HWIO weights.
+    Returns (dx [N, H, W, Cin], dz [N, H, W, Cout])."""
+    n, h, ww, cout = dy.shape
+    cin = w.shape[2]
+    # backward-data kernel: spatial flip + I/O transpose of the forward
+    # weights (constant-folded outside the step loop by XLA)
+    wt = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2)   # [3, 3, Cout, Cin]
+    kernel = _partial(_dx_kernel, hh=h, ww=ww)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, ww, cout), lambda i: (i, 0, 0, 0)),  # dy
+            pl.BlockSpec((1, h, ww, cout), lambda i: (i, 0, 0, 0)),  # z
+            pl.BlockSpec((8, cout), lambda i: (0, 0)),          # coeffs
+            pl.BlockSpec((3, 3, cout, cin), lambda i: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, ww, cin), lambda i: (i, 0, 0, 0)),   # dx
+            pl.BlockSpec((1, h, ww, cout), lambda i: (i, 0, 0, 0)),  # dz
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, ww, cin), dx_dtype),
+            jax.ShapeDtypeStruct((n, h, ww, cout), dz_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h + 2, ww + 2, cout), jnp.float32),  # padded dz
+        ],
+        compiler_params=CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_interpret(),
+    )(dy, z, coeffs, wt)
+
+
+# ------------------------------------------------------------ custom vjp
+@_partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _conv_bn_core(x, w, cb, scale, bias, eps):
+    """Training-mode conv(3×3, s1, p1) + per-batch BatchNorm, NHWC.
+    x [N,H,W,Cin], w [3,3,Cin,Cout] HWIO, cb/scale/bias [Cout].
+    Returns y only; the caller recomputes (m, v) for the running
+    averages (XLA CSEs the conv and the reductions with the ones in
+    here)."""
+    (y, _res) = _core_fwd(x, w, cb, scale, bias, eps)
+    return y
+
+
+def _core_fwd(x, w, cb, scale, bias, eps):
+    from .nn_ops import _bn_apply, _bn_stats
+
+    z = _conv3x3(x, w) + cb.astype(x.dtype)
+    m, v = _bn_stats(z, (0, 1, 2))
+    inv = lax.rsqrt(v + eps)
+    y = _bn_apply(z, scale, bias, m, inv, 3)
+    return y, (x, w, z, cb, scale, m, inv)
+
+
+def _core_bwd(eps, res, dy):
+    """The fused backward.  One XLA reduction pass over (dy, z) yields
+    Σdy and Σdy·x̂ (= dbias, dscale — the BN parameter grads); from
+    those the per-channel affine coefficients of dz are scalars, and
+    the Pallas kernel produces dx and dz in a single pass over HBM.
+    The filter grad runs as XLA's standard backward-filter conv on the
+    kernel's dz output; the conv-bias grad Σdz reduces to channel
+    scalars analytically (A·Σdy + B·N·m + C·N — no tensor pass).
+    Running-average buffers are stop-gradient side-channel state, as
+    everywhere else in this codebase."""
+    x, w, z, cb, scale, m, inv = res
+    cout = z.shape[-1]
+    shape = (1, 1, 1, cout)
+    nelem = np.prod([z.shape[i] for i in (0, 1, 2)]).astype(np.float32)
+    dy_f = dy.astype(jnp.float32)
+    xhat = (z.astype(jnp.float32) - m.reshape(shape)) * inv.reshape(shape)
+    dbias = jnp.sum(dy_f, axis=(0, 1, 2))
+    dscale = jnp.sum(dy_f * xhat, axis=(0, 1, 2))
+
+    a_c = scale.astype(jnp.float32) * inv
+    b_c = -a_c * inv * dscale / nelem
+    c_c = a_c * (inv * m * dscale - dbias) / nelem
+    coeffs = jnp.zeros((8, cout), jnp.float32) \
+        .at[0].set(a_c).at[1].set(b_c).at[2].set(c_c)
+
+    dx, dz = _dx_call(dy, z, coeffs, w, x.dtype, z.dtype)
+    # filter grad: XLA's native backward-filter conv over the dz the
+    # kernel just wrote (jax.vjp emits the canonical transpose conv)
+    _, conv_vjp = jax.vjp(lambda w_: _conv3x3(x, w_), w)
+    dw, = conv_vjp(dz)
+    dcb = a_c * dbias + b_c * (nelem * m) + c_c * nelem
+    return (dx, dw.astype(w.dtype), dcb.astype(cb.dtype),
+            dscale.astype(scale.dtype), dbias.astype(scale.dtype))
+
+
+def _core_fwd_rule(x, w, cb, scale, bias, eps):
+    y, res = _core_fwd(x, w, cb, scale, bias, eps)
+    return y, res
+
+
+_conv_bn_core.defvjp(_core_fwd_rule, _core_bwd)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_attention import _interpret
+from .pallas_attention import CompilerParams, _interpret
 from .pallas_lstm import fused_ok  # same B/H tiling + VMEM gate
 
 
@@ -84,7 +84,7 @@ def _fwd_call(xw, mask, w_gates, w_cand, h0):
             jax.ShapeDtypeStruct((t, b, hd3), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((b, hd), jnp.float32)],    # h carry
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(xw, mask, w_gates, w_cand, h0)
@@ -162,7 +162,7 @@ def _bwd_call(gates, h_prev_seq, mask, w_gates, w_cand, dy):
             jax.ShapeDtypeStruct((b, hd), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((b, hd), jnp.float32)],    # dh carry
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(gates, h_prev_seq, mask, w_gates, w_cand, dy)

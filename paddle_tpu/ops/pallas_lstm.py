@@ -39,7 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-from .pallas_attention import _interpret  # shared backend-dispatch gate
+from .pallas_attention import CompilerParams, _interpret  # shared gate
 
 
 def fused_ok(b: int, h: int) -> bool:
@@ -47,7 +47,10 @@ def fused_ok(b: int, h: int) -> bool:
     tests exercise the hardware dispatch.  H is capped so the backward
     kernel's resident f32 w_hh [H, 4H] (H·4H·4 B = 4 MB at H=512) plus
     the dW_hh output accumulator (another 4 MB) plus the streamed
-    double-buffered blocks stay inside the 16 MB scoped-vmem budget."""
+    double-buffered blocks stay inside the 16 MB scoped-vmem budget.
+    A False here is no longer silent: the dispatch site
+    (ops/recurrent_ops.py::_warn_scan_fallback) logs the scan fallback
+    once per shape, and bench.py's hidden=1280 row measures it."""
     return b % 8 == 0 and h % 128 == 0 and h <= 512
 
 
@@ -122,7 +125,7 @@ def _fwd_call(xw, mask, w_hh, checks, h0, c0):
             pltpu.VMEM((b, hd), jnp.float32),                 # h carry
             pltpu.VMEM((b, hd), jnp.float32),                 # c carry
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(xw, mask, w_hh, checks, h0, c0)
@@ -230,7 +233,7 @@ def _bwd_call(gates, h_prev_seq, c_prev_seq, c_seq, mask, w_hh, checks,
             pltpu.VMEM((b, hd), jnp.float32),                 # dh carry
             pltpu.VMEM((b, hd), jnp.float32),                 # dc carry
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(gates, h_prev_seq, c_prev_seq, c_seq, mask, w_hh, checks, dy, dyc)

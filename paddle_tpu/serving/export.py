@@ -27,6 +27,9 @@ import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
+# explicit submodule import: pre-0.5 jax does not expose jax.export as
+# an attribute of the bare `import jax`
+import jax.export
 import numpy as np
 
 from ..utils import enforce, get_logger

@@ -18,6 +18,9 @@ import os
 from typing import Any, Dict
 
 import jax
+# explicit submodule import: pre-0.5 jax does not expose jax.export as
+# an attribute of the bare `import jax`
+import jax.export
 import numpy as np
 
 

@@ -116,6 +116,10 @@ FLAGS.define("use_bf16", True, "run matmul/conv compute in bfloat16 on TPU")
 FLAGS.define("bf16_activations", False,
              "store layer activations in bfloat16 (halves activation HBM "
              "traffic; params/losses stay fp32)")
+FLAGS.define("conv_bn_fuse", True,
+             "fuse linear-conv→batch_norm pairs through the Pallas "
+             "backward-data kernel (ops/pallas_conv.py); off = the "
+             "plain composition, for A/B traffic measurement")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

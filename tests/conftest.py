@@ -23,10 +23,32 @@ import jax
 # sitecustomize may have imported jax already (latching JAX_PLATFORMS=axon
 # into jax.config), so update the config directly too.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
+    pass
 
 import numpy as np
 import pytest
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run the full lane: re-include tests marked slow "
+             "(pytest.ini's addopts deselects them by default)")
+
+
+def pytest_configure(config):
+    # drop the default fast-lane filter from pytest.ini when the user
+    # asked for the full lane (--runslow) OR named specific tests by
+    # node id — running `pytest tests/x.py::test_y` must execute the
+    # test, not silently deselect it.  An explicit -m on the command
+    # line still wins (it differs from the pytest.ini default).
+    explicit_ids = any("::" in a for a in config.args)
+    if (config.getoption("--runslow") or explicit_ids) \
+            and config.option.markexpr == "not slow":
+        config.option.markexpr = ""
 
 
 @pytest.fixture

@@ -142,6 +142,7 @@ def test_position_embedding_adds_table_slice():
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_transformer_demo_topology_trains_one_batch():
     """The demo's own builder (demo/transformer/train.py) — imported, so
     demo and test can't drift — must build and take a training step."""
@@ -172,6 +173,7 @@ def test_transformer_demo_topology_trains_one_batch():
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_transformer_classifier_converges():
     """End-to-end: the DSL-built transformer (embedding → pos →
     flash-attention blocks → pool → softmax) separates a toy task where

@@ -251,6 +251,7 @@ def test_split_and_cluster_files_reader(tmp_path):
     assert list(r0()) == [0, 1, 2, 6, 7, 8]   # files 0 and 2
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_cloud_reader_with_master(tmp_path):
     from paddle_tpu.data.download import convert
     from paddle_tpu.data.reader import cloud_reader

@@ -101,6 +101,7 @@ def _loss_inputs(rng, B=2, P=6, G=3, C=4):
             jnp.asarray(gt), jnp.asarray(count))
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_multibox_loss_positive_and_differentiable(rng):
     conf, loc, priors, gt, count = _loss_inputs(rng)
     fn = lambda c, l: D.multibox_loss(c, l, priors, gt, count, num_classes=4,
@@ -246,6 +247,7 @@ def test_conv_shift_layer(rng):
 
 # ------------------------------------------------ multibox loss layer
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_multibox_loss_layer_end_to_end(rng):
     from paddle_tpu.config.model_config import (LayerConfig, LayerInput,
                                                 ModelConfig)

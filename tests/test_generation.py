@@ -96,6 +96,7 @@ def test_beam1_matches_greedy_hand_rollout():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_train_then_generate_pattern():
     """Teacher-forced training topology + generation topology sharing
     weights by name: after training on a constant target pattern, the

@@ -76,6 +76,7 @@ def _train_mnist_config(tmp_path):
     return str(cfg)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_merge_model_cli_round_trip(tmp_path):
     """Train 1 step, checkpoint, merge via CLI, load merged, and get
     IDENTICAL logits from the merged file's config+params."""
@@ -142,6 +143,7 @@ def test_merge_model_reads_reference_layout_dir(tmp_path):
         assert params2[name].shape == params[name].shape
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_dump_config_cli(tmp_path):
     cfg_path = _train_mnist_config(tmp_path)
     env = dict(os.environ, JAX_PLATFORMS="cpu",

@@ -113,6 +113,7 @@ def test_two_process_global_mesh():
     assert loss_lines[0] == loss_lines[1], loss_lines
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_cli_master_subcommand(tmp_path):
     """`paddle master --dataset ... --chunked` serves chunk tasks over
     TCP (the standalone coordinator binary of the reference era)."""

@@ -48,6 +48,7 @@ def test_resnet_cifar10_forward():
     np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_deep_net_finite_at_init():
     """Activation magnitudes must not explode through 50 layers (guards
     the smart-init fan-in fix for conv weights)."""

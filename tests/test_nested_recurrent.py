@@ -10,6 +10,7 @@ sequence.  Outputs AND parameter gradients must match.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,7 @@ def _build_flat():
     return dsl.topology(out)
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_nested_group_equals_flat(rng):
     with config_scope():
         cfg_n = _build_nested()

@@ -217,6 +217,7 @@ def test_reduce_ops():
     check_grad("reduce_mean", {"X": [x]}, ["X"], {"dim": 1})
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_shape_glue_ops():
     x = _x(2, 6)
     check_output("reshape", {"X": [x]}, x.reshape(3, 4), {"shape": [3, 4]})

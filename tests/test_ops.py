@@ -70,6 +70,7 @@ def test_multiplex(rng):
     np.testing.assert_allclose(np.asarray(out)[:, 0], [2, 0, 3])
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_conv2d_matches_torch(rng):
     torch = pytest.importorskip("torch")
     import torch.nn.functional as F

@@ -1,0 +1,331 @@
+"""Fused Pallas conv backward-data + BN affine ≡ the unfused path.
+
+The fused conv→BN op (``ops/pallas_conv.py``, the ``hl_cuda_cudnn``
+fused conv/BN tier) must be numerically interchangeable with the plain
+``lax.conv_general_dilated`` + batch-norm composition it replaces —
+forward, running-stat updates, and gradients through every input, across
+the 3×3 stride-1 family including edge shapes.  The network-level
+peephole must fire exactly on the linear-conv→batch-norm pattern.  Runs
+in Pallas interpret mode on CPU (same dispatch gate as hardware).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from paddle_tpu.ops import nn_ops, pallas_conv
+
+EPS = 1e-5
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def _inputs(rng, n, h, w, cin, cout, with_cb=True):
+    x = jnp.asarray(rng.randn(n, h, w, cin).astype(np.float32)) * 0.5
+    wt = jnp.asarray(rng.randn(3, 3, cin, cout).astype(np.float32)) * 0.1
+    cb = (jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.1
+          if with_cb else None)
+    scale = jnp.asarray(rng.rand(cout).astype(np.float32) + 0.5)
+    bias = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.2
+    rm = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.1
+    rv = jnp.asarray(rng.rand(cout).astype(np.float32) + 0.5)
+    return x, wt, cb, scale, bias, rm, rv
+
+
+def _reference(x, w, cb, scale, bias, rm, rv, momentum=0.9,
+               is_training=True):
+    """Plain-jax oracle: lax conv + textbook batch norm, autodiffed."""
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    z = lax.conv_general_dilated(x, w, (1, 1), [(1, 1), (1, 1)],
+                                 dimension_numbers=dn)
+    if cb is not None:
+        z = z + cb
+    if not is_training:
+        return (z - rm) * lax.rsqrt(rv + EPS) * scale + bias, rm, rv
+    m = jnp.mean(z, (0, 1, 2))
+    v = jnp.maximum(jnp.mean(jnp.square(z), (0, 1, 2)) - m * m, 0.0)
+    y = (z - m) * lax.rsqrt(v + EPS) * scale + bias
+    return y, momentum * rm + (1 - momentum) * m, \
+        momentum * rv + (1 - momentum) * v
+
+
+def _assert_close(got, want, rtol=2e-5, atol=2e-5):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=rtol, atol=atol)
+
+
+# ------------------------------------------------------------- dispatch
+def test_dispatch_gate():
+    ok = pallas_conv.fusable
+    w3 = (3, 3, 64, 64)
+    x4 = (2, 8, 8, 64)
+    assert ok(x4, w3, 1, [(1, 1), (1, 1)], 1, 1, "NHWC")
+    assert ok(x4, w3, 1, "SAME", 1, 1, "NHWC")
+    assert ok(x4, w3, (1, 1), 1, (1, 1), 1, "NHWC")
+    assert not ok(x4, w3, 2, 1, 1, 1, "NHWC")           # stride
+    assert not ok(x4, w3, 1, 0, 1, 1, "NHWC")           # VALID pad
+    assert not ok(x4, w3, 1, 1, 2, 1, "NHWC")           # dilation
+    assert not ok(x4, w3, 1, 1, 1, 2, "NHWC")           # groups
+    assert not ok(x4, (5, 5, 64, 64), 1, 2, 1, 1, "NHWC")  # 5×5
+    assert not ok(x4, w3, 1, 1, 1, 1, "NCHW")           # layout
+    assert not ok((2, 8, 8, 48), (3, 3, 48, 64), 1, 1, 1, 1,
+                  "NHWC")                               # Cin % 64
+    assert not ok((2, 8, 8, 64), (3, 3, 64, 48), 1, 1, 1, 1,
+                  "NHWC")                               # Cout % 64
+    # ResNet-50's whole 3×3 family tiles; a hypothetical giant doesn't
+    assert pallas_conv.fused_ok(56, 56, 64, 64)
+    assert pallas_conv.fused_ok(28, 28, 128, 128)
+    assert pallas_conv.fused_ok(14, 14, 256, 256)
+    assert pallas_conv.fused_ok(7, 7, 512, 512)
+    assert not pallas_conv.fused_ok(224, 224, 256, 256)  # VMEM
+
+
+# --------------------------------------------------- fused ≡ reference
+@pytest.mark.parametrize("shape", [
+    (2, 5, 7, 64, 64),      # odd H/W, the smallest fused channels
+    (1, 4, 4, 128, 64),     # Cin ≠ Cout, contracting
+    (2, 3, 3, 64, 128),     # expanding, spatial == kernel
+])
+def test_fused_forward_and_stats_match_reference(rng, shape):
+    n, h, w, cin, cout = shape
+    args = _inputs(rng, n, h, w, cin, cout)
+    assert pallas_conv.fusable((n, h, w, cin), (3, 3, cin, cout),
+                               1, 1, 1, 1, "NHWC")
+    got = nn_ops.conv2d_bn(*args, eps=EPS, is_training=True, padding=1)
+    want = _reference(*args)
+    for g, r in zip(got, want):
+        _assert_close(g, r)
+
+
+def test_fused_gradients_match_reference(rng):
+    n, h, w, cin, cout = 2, 5, 7, 64, 64
+    x, wt, cb, scale, bias, rm, rv = _inputs(rng, n, h, w, cin, cout)
+    cot = jnp.asarray(rng.randn(n, h, w, cout).astype(np.float32))
+
+    def loss_fused(x, wt, cb, scale, bias):
+        y, _, _ = nn_ops.conv2d_bn(x, wt, cb, scale, bias, rm, rv,
+                                   eps=EPS, is_training=True, padding=1)
+        return jnp.sum(y * cot)
+
+    def loss_ref(x, wt, cb, scale, bias):
+        y, _, _ = _reference(x, wt, cb, scale, bias, rm, rv)
+        return jnp.sum(y * cot)
+
+    args = (x, wt, cb, scale, bias)
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(*args)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(*args)
+    # conv bias pre-BN is analytically gradient-free (BN subtracts the
+    # mean), so both sides are f32 noise around 0 — compare by atol
+    # scaled to the other gradients' magnitude
+    names = ["dx", "dw", "dconv_bias", "dscale", "dbias"]
+    for name, gf, gr in zip(names, g_fused, g_ref):
+        tol = dict(rtol=3e-4, atol=1e-3) if name == "dconv_bias" \
+            else dict(rtol=3e-4, atol=3e-5)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   err_msg=name, **tol)
+
+
+def test_fused_gradients_no_conv_bias(rng):
+    n, h, w, cin, cout = 1, 4, 6, 64, 64
+    x, wt, _, scale, bias, rm, rv = _inputs(rng, n, h, w, cin, cout,
+                                            with_cb=False)
+    cot = jnp.asarray(rng.randn(n, h, w, cout).astype(np.float32))
+
+    def loss(fn, x, wt, scale, bias):
+        y, _, _ = fn(x, wt, None, scale, bias, rm, rv)
+        return jnp.sum(y * cot)
+
+    fused = lambda *a: nn_ops.conv2d_bn(*a, eps=EPS, is_training=True,
+                                        padding=1)
+    ref = lambda *a: _reference(*a)
+    argnums = (0, 1, 2, 3)
+    g_fused = jax.grad(lambda *a: loss(fused, *a), argnums=argnums)(
+        x, wt, scale, bias)
+    g_ref = jax.grad(lambda *a: loss(ref, *a), argnums=argnums)(
+        x, wt, scale, bias)
+    for gf, gr in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=3e-4, atol=3e-5)
+
+
+# ------------------------------------------------- fallback equivalence
+@pytest.mark.parametrize("shape", [
+    (2, 5, 5, 48, 64),      # Cin off-tile → plain path
+    (2, 5, 5, 3, 16),       # the resnet_cifar10 stem shapes
+])
+def test_edge_channels_fall_back_and_match(rng, shape):
+    n, h, w, cin, cout = shape
+    args = _inputs(rng, n, h, w, cin, cout)
+    assert not pallas_conv.fusable((n, h, w, cin), (3, 3, cin, cout),
+                                   1, 1, 1, 1, "NHWC")
+    got = nn_ops.conv2d_bn(*args, eps=EPS, is_training=True, padding=1)
+    want = _reference(*args)
+    for g, r in zip(got, want):
+        _assert_close(g, r)
+
+
+def test_eval_mode_matches_composition(rng):
+    n, h, w, c = 2, 5, 7, 64
+    args = _inputs(rng, n, h, w, c, c)
+    got = nn_ops.conv2d_bn(*args, eps=EPS, is_training=False, padding=1)
+    want = _reference(*args, is_training=False)
+    for g, r in zip(got, want):
+        _assert_close(g, r)
+
+
+def test_fused_matches_under_bf16_policy(rng):
+    """The production-default bf16 policy: fused and unfused paths agree
+    within bf16 rounding (both compute the conv in bf16)."""
+    from paddle_tpu.utils import FLAGS
+
+    FLAGS.set("bf16_activations", True)
+    try:
+        n, h, w, c = 2, 4, 4, 64
+        x, wt, cb, scale, bias, rm, rv = _inputs(rng, n, h, w, c, c)
+        y, _, _ = nn_ops.conv2d_bn(x, wt, cb, scale, bias, rm, rv,
+                                   eps=EPS, is_training=True, padding=1)
+        z = nn_ops.conv2d(x, wt, stride=1, padding=1) + cb
+        y2, _, _ = nn_ops.batch_norm(z, scale, bias, rm, rv, eps=EPS,
+                                     is_training=True)
+        np.testing.assert_allclose(np.asarray(y, np.float32),
+                                   np.asarray(y2, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+    finally:
+        FLAGS.set("bf16_activations", False)
+
+
+# ----------------------------------------------------- network peephole
+def _build_net(conv_act=None, filter_size=3, stride=1, padding=1,
+               second_consumer=False, channels=64):
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector
+    from paddle_tpu.layers.network import NeuralNetwork
+
+    img_sz = 6
+    with config_scope():
+        img = dsl.data("image", dense_vector(channels * img_sz * img_sz),
+                       height=img_sz, width=img_sz)
+        conv = dsl.img_conv(
+            img, filter_size=filter_size, num_filters=channels,
+            stride=stride, padding=padding, num_channels=channels,
+            act=conv_act or dsl.LinearActivation(), name="c1")
+        bn = dsl.batch_norm(conv, act=dsl.ReluActivation(), name="bn1")
+        if second_consumer:
+            out = dsl.addto([bn, conv], name="sum")
+            cfg = dsl.topology(out)
+        else:
+            cfg = dsl.topology(bn)
+    return NeuralNetwork(cfg)
+
+
+def test_peephole_fires_on_intended_pattern():
+    from paddle_tpu.config.dsl import ReluActivation
+
+    assert _build_net()._conv_bn_fuse == {"bn1": "c1"}
+    # anything off-pattern must NOT fire
+    assert _build_net(conv_act=ReluActivation())._conv_bn_fuse == {}
+    assert _build_net(filter_size=5, padding=2)._conv_bn_fuse == {}
+    assert _build_net(stride=2)._conv_bn_fuse == {}
+    assert _build_net(padding=0)._conv_bn_fuse == {}
+    # conv consumed by a second layer keeps its standalone value
+    assert _build_net(second_consumer=True)._conv_bn_fuse == {}
+
+
+def test_peephole_respects_non_layer_consumers():
+    """Consumers that read values by name outside layer input lists —
+    evaluators here — must block the fusion, or the conv's value would
+    be missing from the forward values dict when they look it up."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector
+    from paddle_tpu.layers.network import NeuralNetwork
+
+    with config_scope():
+        img = dsl.data("image", dense_vector(64 * 6 * 6), height=6,
+                       width=6)
+        conv = dsl.img_conv(img, filter_size=3, num_filters=64, stride=1,
+                            padding=1, num_channels=64,
+                            act=dsl.LinearActivation(), name="c1")
+        bn = dsl.batch_norm(conv, act=dsl.ReluActivation(), name="bn1")
+        cfg = dsl.topology(bn)
+    cfg.evaluators.append({"type": "value_printer", "name": "vp",
+                           "input_layer_name": "c1"})
+    assert NeuralNetwork(cfg)._conv_bn_fuse == {}
+
+
+def test_peephole_network_gradients_match_unfused(rng):
+    net = _build_net()
+    assert net._conv_bn_fuse == {"bn1": "c1"}
+    params = net.init_params(seed=1)
+    buffers = net.init_buffers()
+    feed = {"image": jnp.asarray(
+        rng.randn(4, 64 * 6 * 6).astype(np.float32))}
+
+    def run(params, fuse):
+        saved = net._conv_bn_fuse
+        net._conv_bn_fuse = saved if fuse else {}
+        try:
+            values, bufs = net.forward(params, feed, dict(buffers),
+                                       is_training=True)
+        finally:
+            net._conv_bn_fuse = saved
+        return values, bufs
+
+    v1, b1 = run(params, True)
+    v0, b0 = run(params, False)
+    # the conv's standalone value is fused away; outputs and the
+    # running-stat buffer updates are unchanged
+    assert "c1" not in v1 and "c1" in v0
+    _assert_close(v1["bn1"], v0["bn1"])
+    for k in b0:
+        _assert_close(b1[k], b0[k])
+
+    def loss(params, fuse):
+        values, _ = run(params, fuse)
+        return jnp.sum(values["bn1"] ** 2)
+
+    g1 = jax.grad(lambda p: loss(p, True))(params)
+    g0 = jax.grad(lambda p: loss(p, False))(params)
+    for k in sorted(g0):
+        tol = dict(rtol=3e-4, atol=1e-3) if k.endswith("c1.wbias") \
+            else dict(rtol=3e-4, atol=3e-4)
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g0[k]),
+                                   err_msg=k, **tol)
+
+
+def test_peephole_eval_forward_matches(rng):
+    net = _build_net()
+    params = net.init_params(seed=2)
+    buffers = net.init_buffers()
+    feed = {"image": jnp.asarray(
+        rng.randn(2, 64 * 6 * 6).astype(np.float32))}
+    v1, _ = net.forward(params, feed, dict(buffers), is_training=False)
+    saved = net._conv_bn_fuse
+    net._conv_bn_fuse = {}
+    try:
+        v0, _ = net.forward(params, feed, dict(buffers),
+                            is_training=False)
+    finally:
+        net._conv_bn_fuse = saved
+    _assert_close(v1["bn1"], v0["bn1"])
+
+
+def test_second_consumer_keeps_conv_value(rng):
+    """Off-pattern network (conv feeds BN *and* addto): values flow as
+    before — the conv's output is materialized and consumed twice."""
+    net = _build_net(second_consumer=True)
+    params = net.init_params(seed=3)
+    buffers = net.init_buffers()
+    feed = {"image": jnp.asarray(
+        rng.randn(2, 64 * 6 * 6).astype(np.float32))}
+    values, _ = net.forward(params, feed, dict(buffers),
+                            is_training=True)
+    assert "c1" in values and "sum" in values
+    assert np.isfinite(np.asarray(values["sum"])).all()

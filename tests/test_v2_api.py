@@ -12,6 +12,7 @@ from paddle_tpu.config.dsl import config_scope
 from paddle_tpu.trainer import events as ev
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_mnist_mlp_trains():
     with config_scope():
         images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
@@ -53,6 +54,7 @@ def test_mnist_mlp_trains():
         assert metrics["classification_error"] < 0.2
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_uci_housing_regression():
     with config_scope():
         x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
@@ -76,6 +78,7 @@ def test_uci_housing_regression():
         assert costs[-1] < costs[0] * 0.3, costs
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_sequence_lstm_classification():
     """Stacked-LSTM-style sentiment classifier on synthetic IMDB."""
     with config_scope():
@@ -264,6 +267,7 @@ def test_v2_master_client_tcp():
     c.close()
 
 
+@pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
 def test_recommender_system_trains():
     """Dual-tower MovieLens recommender (test_recommender_system.py):
     cos-sim rating regression over id/bag/text-conv features.  Reuses
