@@ -61,6 +61,32 @@ class _ImgLayer(Layer):
         return val
 
 
+class DeferredBN:
+    """Value published by a batch-norm layer whose normalize+activation
+    apply pass is DEFERRED into its consuming conv's input pipeline (the
+    forward conv+BN fusion, ``nn_ops.affine_act_conv2d``): the raw input
+    ``z`` plus the folded per-channel affine, so the consumer forms
+    ``act(a·z + c)`` tile-by-tile in VMEM instead of reading a
+    materialized activation from HBM.  ``act``/``training`` are static
+    pytree aux data — they gate kernel dispatch, not values."""
+
+    __slots__ = ("z", "a", "c", "act", "training")
+
+    def __init__(self, z, a, c, act: str, training: bool):
+        self.z = z
+        self.a = a
+        self.c = c
+        self.act = act
+        self.training = training
+
+
+jax.tree_util.register_pytree_node(
+    DeferredBN,
+    lambda d: ((d.z, d.a, d.c), (d.act, d.training)),
+    lambda aux, ch: DeferredBN(ch[0], ch[1], ch[2], aux[0], aux[1]),
+)
+
+
 @register_layer("exconv", "cudnn_conv", "conv", "mkldnn_conv")
 class ConvLayer(_ImgLayer):
     def _shapes(self):
@@ -95,10 +121,24 @@ class ConvLayer(_ImgLayer):
 
     def forward(self, params, inputs, ctx):
         c, (h, w), stride, pad, groups = self.geometry()
-        x = to_nhwc(value_of(inputs[0]), c, h, w)
-        out = nn_ops.conv2d(x, params[self.weight_name(0)], stride=stride,
-                            padding=[(pad[0], pad[0]), (pad[1], pad[1])],
-                            groups=groups)
+        v = value_of(inputs[0])
+        if isinstance(v, DeferredBN):
+            # the producing batch-norm deferred its apply pass into this
+            # conv's input pipeline (forward conv+BN fusion): stream the
+            # affine(+act) through the fused conv instead of reading a
+            # materialized activation
+            out = nn_ops.affine_act_conv2d(
+                to_nhwc(v.z, c, h, w), v.a, v.c,
+                params[self.weight_name(0)], act=v.act,
+                is_training=v.training, stride=stride,
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                groups=groups)
+        else:
+            out = nn_ops.conv2d(
+                to_nhwc(v, c, h, w), params[self.weight_name(0)],
+                stride=stride,
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                groups=groups)
         if self.conf.with_bias:
             out = out + params[self.bias_name()]
         return self.finalize(like(inputs[0], out), ctx)
@@ -215,7 +255,7 @@ class BatchNormLayer(_ImgLayer):
         return self.finalize(like(inputs[0], y), ctx)
 
     def _bn_args(self, params):
-        """(scale, bias, momentum) shared by both forward paths."""
+        """(scale, bias, momentum) shared by all forward paths."""
         c = self.conf.attrs.get("channels", self.conf.size)
         bias = params.get(self.bias_name())
         if bias is None:
@@ -223,15 +263,54 @@ class BatchNormLayer(_ImgLayer):
         return params[self.weight_name(0)], bias, \
             self.conf.attrs.get("moving_average_fraction", 0.9)
 
+    def forward_deferred(self, params, inputs, ctx):
+        """Publish the folded affine instead of applying it (forward
+        conv+BN fusion, network peephole): this BN's sole consumer is a
+        fusable conv, which receives the raw input z plus the folded
+        per-channel (a, c) and streams ``act(a·z + c)`` through its
+        input pipeline — the normalize+act apply pass never touches
+        HBM.  Running-stat buffers update exactly as :meth:`forward`;
+        eval mode folds the running stats the same way (the consumer
+        then takes the exact unfused composition)."""
+        c = self.conf.attrs.get("channels", self.conf.size)
+        v = value_of(inputs[0])
+        img = v
+        if v.ndim == 2 and self.conf.attrs.get("img_size") is not None:
+            h = self.geo("img_size_y", self.conf.attrs.get("img_size"))
+            w = self.geo("img_size")
+            img = to_nhwc(v, c, h, w)
+        scale, bias, momentum = self._bn_args(params)
+        rm = ctx.buffers.get(self.name + ".mean",
+                             jnp.zeros((c,), jnp.float32))
+        rv = ctx.buffers.get(self.name + ".var",
+                             jnp.ones((c,), jnp.float32))
+        use_global = self.conf.attrs.get("use_global_stats", None)
+        training = ctx.is_training if use_global is None else not use_global
+        a, cc, nrm, nrv = nn_ops.bn_folded_affine(
+            img, scale, bias, rm, rv, momentum=momentum,
+            is_training=training)
+        ctx.new_buffers[self.name + ".mean"] = nrm
+        ctx.new_buffers[self.name + ".var"] = nrv
+        act = "relu" if self.conf.active_type == "relu" else ""
+        return DeferredBN(img, a, cc, act, training)
+
     def forward_fused(self, params, conv, conv_inputs, ctx):
         """Execute the fused conv→BN pair (network peephole): ``conv``
         is the producing :class:`ConvLayer`, ``conv_inputs`` its inputs.
         Semantics are exactly conv-forward (linear act, gated) followed
         by :meth:`forward`; ``nn_ops.conv2d_bn`` dispatches the Pallas
         fused-backward path when the shapes tile and falls back to the
-        identical unfused composition otherwise (and in eval mode)."""
+        identical unfused composition otherwise (and in eval mode).
+        A :class:`DeferredBN` input composes the FORWARD fusion into the
+        same pair — the upstream BN's affine(+ReLU) becomes the chain
+        op's input prologue."""
         c, (h, w), stride, pad, groups = conv.geometry()
-        x = to_nhwc(value_of(conv_inputs[0]), c, h, w)
+        v = value_of(conv_inputs[0])
+        in_affine = None
+        if isinstance(v, DeferredBN):
+            in_affine = (v.a, v.c, v.act)
+            v = v.z
+        x = to_nhwc(v, c, h, w)
         cw = params[conv.weight_name(0)]
         cb = params.get(conv.bias_name()) if conv.conf.with_bias else None
         scale, bias, momentum = self._bn_args(params)
@@ -244,7 +323,8 @@ class BatchNormLayer(_ImgLayer):
         y, nrm, nrv = nn_ops.conv2d_bn(
             x, cw, cb, scale, bias, rm, rv, momentum=momentum,
             is_training=training, stride=stride,
-            padding=[(pad[0], pad[0]), (pad[1], pad[1])], groups=groups)
+            padding=[(pad[0], pad[0]), (pad[1], pad[1])], groups=groups,
+            in_affine=in_affine)
         ctx.new_buffers[self.name + ".mean"] = nrm
         ctx.new_buffers[self.name + ".var"] = nrv
         return self.finalize(like(conv_inputs[0], y), ctx)

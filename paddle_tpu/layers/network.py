@@ -125,9 +125,9 @@ class NeuralNetwork:
         from ..utils import FLAGS
 
         self._conv_bn_fuse: Dict[str, str] = {}
-        conv_types = ("exconv", "cudnn_conv", "conv", "mkldnn_conv")
-        if not FLAGS.get("conv_bn_fuse"):
-            conv_types = ()    # A/B kill switch (--conv_bn_fuse=false)
+        all_conv_types = ("exconv", "cudnn_conv", "conv", "mkldnn_conv")
+        # A/B kill switch (--conv_bn_fuse=false)
+        conv_types = all_conv_types if FLAGS.get("conv_bn_fuse") else ()
         bn_types = ("batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm")
         n_consumers: Dict[str, int] = {}
         for lc in config.layers:
@@ -176,6 +176,58 @@ class NeuralNetwork:
                     and n_consumers.get(pname, 0) == 1
                     and pname not in outputs):
                 self._conv_bn_fuse[lconf.name] = pname
+
+        # BN(+ReLU)→conv FORWARD-fusion peephole (the other direction):
+        # a batch-norm whose sole consumer is a fusable conv defers its
+        # normalize+act apply pass — it publishes (z, a, c) and the conv
+        # streams act(a·z + c) through its input pipeline
+        # (nn_ops.affine_act_conv2d: Pallas 3×3 kernel / 1×1 GEMM
+        # prologue), so the normalized activation never round-trips
+        # HBM.  Same build-time pattern-match discipline as above; the
+        # ops re-gate on shapes and fall back to the exact unfused
+        # composition.  Maps consumer conv name → deferred BN name.
+        self._bn_conv_fuse: Dict[str, str] = {}
+        if FLAGS.get("conv_bn_fuse_fwd"):
+            for lconf in config.layers:     # lconf = the consuming conv
+                if lconf.type not in all_conv_types \
+                        or len(lconf.inputs) != 1 \
+                        or lconf.name not in self.layers:
+                    continue
+                a = lconf.attrs
+                f = a.get("filter_size")
+                fy = a.get("filter_size_y", f)
+                s = a.get("stride", 1)
+                sy = a.get("stride_y", s)
+                p = a.get("padding", 0)
+                py = a.get("padding_y", p)
+                geom3 = (f == 3 and fy == 3 and s == 1 and sy == 1
+                         and p == 1 and py == 1)
+                geom1 = (f == 1 and fy == 1 and s == 1 and sy == 1
+                         and p == 0 and py == 0)
+                if not (geom3 or geom1) or a.get("groups", 1) != 1:
+                    continue
+                pname = lconf.inputs[0].input_layer_name
+                pconf = lmap.get(pname)
+                if pconf is None or pconf.type not in bn_types \
+                        or pname not in self.layers:
+                    continue
+                if (pconf.active_type not in ("", "linear", "relu")
+                        or pconf.drop_rate != 0
+                        or pconf.error_clipping_threshold != 0
+                        or len(pconf.inputs) != 1
+                        or pconf.attrs.get("img_size") is None):
+                    continue
+                if n_consumers.get(pname, 0) != 1 or pname in outputs:
+                    continue
+                self._bn_conv_fuse[lconf.name] = pname
+            # a deferred BN publishes (z, a, c) instead of its applied
+            # output, so it can no longer be the OUTPUT of a
+            # backward-fused pair — its upstream conv reverts to a
+            # standalone value.  (A round-6 entry whose CONV is a fwd
+            # consumer stays: that pair runs as the chain op with the
+            # deferred affine as its input prologue.)
+            for bn in self._bn_conv_fuse.values():
+                self._conv_bn_fuse.pop(bn, None)
 
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:
@@ -290,6 +342,11 @@ class NeuralNetwork:
         fuse = {bn: cv for bn, cv in self._conv_bn_fuse.items()
                 if (needed is None or bn in needed) and cv not in targets}
         fused_convs = set(fuse.values())
+        # BNs whose apply pass defers into their consuming conv this
+        # call (forward fusion) — inactive when the BN's own value is an
+        # explicit target (it must then materialize standalone)
+        defer = {bn for cv, bn in self._bn_conv_fuse.items()
+                 if (needed is None or cv in needed) and bn not in targets}
         for name in self.order:
             if needed is not None and name not in needed:
                 continue
@@ -304,6 +361,16 @@ class NeuralNetwork:
             # run any recurrent group whose inputs are all ready lazily:
             # groups appear in order via their output layers
             with layer_stack.guard(name):
+                if name in defer:
+                    # forward conv+BN fusion: publish (z, a, c) — the
+                    # consuming conv applies the affine in its input
+                    # pipeline (no activation materialized here)
+                    inputs = self._gather(layer.conf.input_names(),
+                                          params, values, ctx,
+                                          done_groups)
+                    values[name] = layer.forward_deferred(params, inputs,
+                                                          ctx)
+                    continue
                 src = fuse.get(name)
                 if src is not None:
                     conv = self.layers[src]

@@ -393,12 +393,161 @@ def batch_norm(x, scale, bias, running_mean, running_var,
     return y, running_mean, running_var
 
 
+def _gemm_prologue_ok(x_shape, w_shape, stride, padding, dilation,
+                      groups, data_format) -> bool:
+    """Static gate for the 1×1 GEMM-prologue path of
+    :func:`affine_act_conv2d`: the same family the plain-GEMM ``conv2d``
+    fast path accepts (1×1 stride-1 NHWC, groups=1, zero pad)."""
+    if data_format != "NHWC" or groups != 1:
+        return False
+    if len(x_shape) != 4 or len(w_shape) != 4 \
+            or tuple(w_shape[:2]) != (1, 1):
+        return False
+    if _pair(stride) != (1, 1) or _pair(dilation) != (1, 1):
+        return False
+    if isinstance(padding, str):
+        return padding in ("SAME", "VALID")
+    if isinstance(padding, int):
+        return padding == 0
+    pads = [_pair(p) for p in padding]
+    return pads == [(0, 0), (0, 0)]
+
+
+def _affine_apply(z, a, c, act: str):
+    """The unfused BN-apply formula: act(a·z + c) in z's dtype — the
+    exact composition the fused paths replace (and fall back to)."""
+    x = z * a.astype(z.dtype) + c.astype(z.dtype)
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act in ("", "linear"):
+        return x
+    from . import get_activation
+
+    return get_activation(act)(x)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _affine_conv1x1_core(z, a, c, w, relu):
+    """act(a·z + c) @ w — the 1×1 stride-1 GEMM conv with the upstream
+    BN's folded affine (+ReLU) as a fused prologue.  Stating the
+    elementwise prologue inline hands XLA the GEMM operand to fuse it
+    into, and the custom backward recomputes x from the raw z residual
+    instead of saving the normalized activation — the same recompute
+    discipline as the Pallas 3×3 path."""
+    return _affine_1x1_fwd(z, a, c, w, relu)[0]
+
+
+def _affine_1x1_fwd(z, a, c, w, relu):
+    n, h, ww, cin = z.shape
+    x = _affine_apply(z, a, c, "relu" if relu else "")
+    out = (x.reshape(n * h * ww, cin) @ w.reshape(cin, -1)) \
+        .reshape(n, h, ww, -1)
+    return out, (z, a, c, w)
+
+
+def _affine_1x1_bwd(relu, res, dy):
+    z, a, c, w = res
+    n, h, ww, cin = z.shape
+    cout = w.shape[3]
+    # mask/x recomputed from z exactly as the forward formed them
+    u = z * a.astype(z.dtype) + c.astype(z.dtype)
+    x = jax.nn.relu(u) if relu else u
+    t = (dy.reshape(n * h * ww, cout) @ w.reshape(cin, cout).T) \
+        .reshape(z.shape).astype(jnp.float32)
+    du = jnp.where(u > 0, t, 0.0) if relu else t
+    dz = (a * du).astype(z.dtype)
+    da = jnp.sum(z.astype(jnp.float32) * du, axis=(0, 1, 2))
+    dc = jnp.sum(du, axis=(0, 1, 2))
+    dw = jax.lax.dot_general(
+        x.reshape(n * h * ww, cin), dy.reshape(n * h * ww, cout),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(w.shape)
+    return dz, da.astype(a.dtype), dc.astype(c.dtype), dw.astype(w.dtype)
+
+
+_affine_conv1x1_core.defvjp(_affine_1x1_fwd, _affine_1x1_bwd)
+
+
+@register_op("affine_act_conv2d")
+def affine_act_conv2d(z, a, c, w, conv_bias=None, act: str = "relu",
+                      is_training: bool = True, stride: IntOr2 = 1,
+                      padding="SAME", dilation: IntOr2 = 1,
+                      groups: int = 1, data_format: str = "NHWC"):
+    """Fused BN-affine(+act)→conv forward: y = conv(act(a·z + c), w).
+
+    The forward half of the fused conv/BN family: ``a``/``c`` are the
+    upstream batch-norm's folded per-channel scale/offset (train-mode
+    batch stats or eval-mode running stats — folded identically), and
+    the normalized activation never materializes in HBM.  Dispatch:
+
+    - 3×3 stride-1 pad-1 NHWC with 64-multiple channels → the Pallas
+      forward kernel (:mod:`paddle_tpu.ops.pallas_conv`), the affine
+      applied in its VMEM input pipeline;
+    - 1×1 stride-1 NHWC → the plain-GEMM conv path with the affine as
+      a fused GEMM prologue (custom backward, raw-z residuals);
+    - anything else — eval mode, off-tile channels, stride-2, other
+      activations — the exact unfused composition.
+
+    Gradients flow into z, a, c, and w; the caller owns the BN-side
+    chain rule from (a, c) back to scale/bias and the batch stats.
+    """
+    from . import pallas_conv
+
+    pol = current_policy()
+    relu = act == "relu"
+    zs, ws = jnp.shape(z), jnp.shape(w)
+    fusable_act = act in ("relu", "", "linear")
+    if is_training and fusable_act and pallas_conv.fusable_fwd(
+            zs, ws, stride, padding, dilation, groups, data_format):
+        out = pallas_conv._affine_conv_core(
+            z.astype(pol.compute_dtype), a.astype(jnp.float32),
+            c.astype(jnp.float32), w.astype(pol.compute_dtype), relu)
+        out = out.astype(pol.output_dtype)
+    elif is_training and fusable_act and _gemm_prologue_ok(
+            zs, ws, stride, padding, dilation, groups, data_format):
+        out = _affine_conv1x1_core(
+            z.astype(pol.compute_dtype), a.astype(jnp.float32),
+            c.astype(jnp.float32), w.astype(pol.compute_dtype), relu)
+        out = out.astype(pol.output_dtype)
+    else:
+        out = conv2d(_affine_apply(z, a, c, act), w, stride=stride,
+                     padding=padding, dilation=dilation, groups=groups,
+                     data_format=data_format)
+    if conv_bias is not None:
+        out = out + conv_bias
+    return out
+
+
+def bn_folded_affine(x, scale, bias, running_mean, running_var,
+                     momentum: float = 0.9, eps: float = 1e-5,
+                     is_training: bool = True, data_format: str = "NHWC"):
+    """The folded per-channel affine of :func:`batch_norm` WITHOUT
+    applying it, plus the running-stat update: returns
+    ``(a, c, new_rm, new_rv)`` with ``batch_norm(x, ...) ==
+    act(a·x + c)`` elementwise.  This is the deferred form consumed by
+    :func:`affine_act_conv2d` (forward conv+BN fusion); keeping it next
+    to ``batch_norm`` pins both paths to the same stats/eps/momentum
+    conventions."""
+    axes, _c_ax = _bn_axes(x.ndim, data_format)
+    if is_training:
+        m, v = _bn_stats(x, axes)
+        new_rm = momentum * running_mean + (1 - momentum) * m
+        new_rv = momentum * running_var + (1 - momentum) * v
+    else:
+        m, v = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = lax.rsqrt(v + eps)
+    a = (scale * inv).astype(jnp.float32)
+    c = (bias - m * a).astype(jnp.float32)
+    return a, c, new_rm, new_rv
+
+
 @register_op("conv2d_bn", n_outputs=3)
 def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
               momentum: float = 0.9, eps: float = 1e-5,
               is_training: bool = True, stride: IntOr2 = 1,
               padding="SAME", dilation: IntOr2 = 1, groups: int = 1,
-              data_format: str = "NHWC"):
+              data_format: str = "NHWC", in_affine=None):
     """Fused conv + batch-norm (training): same contract as
     ``conv2d`` (+ optional conv bias) followed by ``batch_norm``, but
     for the 3×3 stride-1 NHWC family the backward runs through the
@@ -411,11 +560,39 @@ def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
     composition — same results either way, pinned by
     ``tests/test_pallas_conv.py``.
 
+    ``in_affine=(a, c, act)`` composes the FORWARD fusion into the same
+    pair: ``x`` is then the upstream BN's raw input z and the pair
+    computes BN(conv(act(a·z + c)) + cb) with the prologue streamed
+    through the Pallas kernels' input pipelines in both directions
+    (``pallas_conv._chain_core``).  Off-family shapes materialize the
+    affine exactly (the unfused BN apply) and continue as a plain pair.
+
     Returns (y, new_running_mean, new_running_var) like ``batch_norm``.
     """
     from . import pallas_conv
 
     pol = current_policy()
+    if in_affine is not None:
+        a1, c1, act1 = in_affine
+        xs, ws = jnp.shape(x), jnp.shape(w)
+        if (is_training and act1 in ("relu", "", "linear")
+                and pallas_conv.fusable(xs, ws, stride, padding,
+                                        dilation, groups, data_format)
+                and pallas_conv.fused_chain_ok(
+                    xs[1], xs[2], int(ws[2]), int(ws[3]))):
+            xc = x.astype(pol.compute_dtype)
+            wc = w.astype(pol.compute_dtype)
+            cb = jnp.zeros((wc.shape[3],), jnp.float32) \
+                if conv_bias is None else conv_bias
+            y, m, v = pallas_conv._chain_core(
+                xc, a1.astype(jnp.float32), c1.astype(jnp.float32), wc,
+                cb, scale, bias, eps, act1 == "relu")
+            new_rm = momentum * running_mean + (1 - momentum) * m
+            new_rv = momentum * running_var + (1 - momentum) * v
+            return y.astype(pol.output_dtype), new_rm, new_rv
+        # outside the chain family: materialize the affine exactly (the
+        # unfused BN apply formula) and continue as a plain conv→BN pair
+        x = _affine_apply(x, a1, c1, act1)
     if not (is_training and pallas_conv.fusable(
             jnp.shape(x), jnp.shape(w), stride, padding, dilation,
             groups, data_format)):

@@ -120,6 +120,14 @@ FLAGS.define("conv_bn_fuse", True,
              "fuse linear-conv→batch_norm pairs through the Pallas "
              "backward-data kernel (ops/pallas_conv.py); off = the "
              "plain composition, for A/B traffic measurement")
+FLAGS.define("conv_bn_fuse_fwd", True,
+             "fuse batch_norm(+relu)→conv pairs on the FORWARD side: "
+             "the BN's per-channel affine + ReLU stream through the "
+             "consuming conv's input pipeline (Pallas 3x3 kernel / 1x1 "
+             "GEMM prologue, ops/pallas_conv.py + ops/nn_ops.py) "
+             "instead of materializing the normalized activation in "
+             "HBM; off = the exact round-6 lowering, for A/B traffic "
+             "measurement")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

@@ -28,8 +28,51 @@ try:
 except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
     pass
 
+import time
+
 import numpy as np
 import pytest
+
+# Quick-lane wall-time budget: the advertised fast path (`pytest` =
+# `-m "not slow"`) measured 278 s in round 6; the guard keeps it from
+# silently creeping past the point where it stops being quick.  Default
+# is a LOUD warning (machines vary and a hard fail would flake CI on
+# slow boxes); set PADDLE_TPU_FAST_LANE_STRICT=1 to turn the breach
+# into a failing exit status.
+FAST_LANE_BUDGET_S = 420
+_SESSION_T0 = None
+
+
+def pytest_sessionstart(session):
+    global _SESSION_T0
+    _SESSION_T0 = time.perf_counter()
+
+
+def _fast_lane_elapsed(config):
+    """Elapsed seconds when this run IS the fast lane, else None."""
+    if _SESSION_T0 is None or config.option.markexpr != "not slow":
+        return None
+    return time.perf_counter() - _SESSION_T0
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    elapsed = _fast_lane_elapsed(config)
+    if elapsed is None or elapsed <= FAST_LANE_BUDGET_S:
+        return
+    tr = terminalreporter
+    tr.section("FAST-LANE BUDGET EXCEEDED", sep="=", red=True, bold=True)
+    tr.line(f"the default quick lane (-m 'not slow') took {elapsed:.0f} s "
+            f"> {FAST_LANE_BUDGET_S} s budget (round-6 reference: 278 s).")
+    tr.line("Move heavyweight tests to @pytest.mark.slow or speed them "
+            "up; set PADDLE_TPU_FAST_LANE_STRICT=1 to make this fail.")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    elapsed = _fast_lane_elapsed(session.config)
+    if (elapsed is not None and elapsed > FAST_LANE_BUDGET_S
+            and os.environ.get("PADDLE_TPU_FAST_LANE_STRICT") == "1"
+            and session.exitstatus == 0):
+        session.exitstatus = 1
 
 
 def pytest_addoption(parser):

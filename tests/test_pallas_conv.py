@@ -7,6 +7,14 @@ forward, running-stat updates, and gradients through every input, across
 the 3×3 stride-1 family including edge shapes.  The network-level
 peephole must fire exactly on the linear-conv→batch-norm pattern.  Runs
 in Pallas interpret mode on CPU (same dispatch gate as hardware).
+
+The round-7 FORWARD fusion (BN affine + ReLU streamed through the
+consuming conv's input pipeline — the 3×3 Pallas kernel and the 1×1
+GEMM prologue, plus the chain composition with the round-6 backward)
+is pinned the same way in the second half of this file: fwd + gradient
+equivalence vs the unfused composition, exact-composition fallbacks on
+every gate miss (eval mode, C=48/C=96, stride-2), and both kill
+switches (--conv_bn_fuse / --conv_bn_fuse_fwd).
 """
 
 import jax
@@ -329,3 +337,303 @@ def test_second_consumer_keeps_conv_value(rng):
                             is_training=True)
     assert "c1" in values and "sum" in values
     assert np.isfinite(np.asarray(values["sum"])).all()
+
+
+# ====================================================== forward fusion
+def _fwd_reference(z, a, c, w, act="relu", conv_bias=None):
+    """Plain-jax oracle for the forward fusion: the unfused BN-apply
+    formula act(a·z + c) followed by the conv, autodiffed."""
+    x = z * a + c
+    if act == "relu":
+        x = jax.nn.relu(x)
+    dn = lax.conv_dimension_numbers(z.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    kh = w.shape[0]
+    pad = [(1, 1), (1, 1)] if kh == 3 else [(0, 0), (0, 0)]
+    out = lax.conv_general_dilated(x, w, (1, 1), pad,
+                                   dimension_numbers=dn)
+    return out + conv_bias if conv_bias is not None else out
+
+
+def _fwd_inputs(rng, n, h, w, cin, cout, kh=3):
+    z = jnp.asarray(rng.randn(n, h, w, cin).astype(np.float32)) * 0.5
+    wt = jnp.asarray(rng.randn(kh, kh, cin, cout).astype(np.float32)) * 0.1
+    a = jnp.asarray(rng.rand(cin).astype(np.float32) + 0.5)
+    c = jnp.asarray(rng.randn(cin).astype(np.float32)) * 0.3
+    return z, a, c, wt
+
+
+def test_fwd_dispatch_gate():
+    ok = pallas_conv.fusable_fwd
+    w3 = (3, 3, 64, 64)
+    z4 = (2, 8, 8, 64)
+    assert ok(z4, w3, 1, [(1, 1), (1, 1)], 1, 1, "NHWC")
+    assert ok(z4, w3, 1, "SAME", 1, 1, "NHWC")
+    assert not ok(z4, w3, 2, 1, 1, 1, "NHWC")           # stride
+    assert not ok(z4, w3, 1, 0, 1, 1, "NHWC")           # VALID pad
+    assert not ok(z4, w3, 1, 1, 2, 1, "NHWC")           # dilation
+    assert not ok(z4, w3, 1, 1, 1, 2, "NHWC")           # groups
+    assert not ok(z4, (5, 5, 64, 64), 1, 2, 1, 1, "NHWC")  # 5×5
+    assert not ok(z4, w3, 1, 1, 1, 1, "NCHW")           # layout
+    assert not ok((2, 8, 8, 48), (3, 3, 48, 64), 1, 1, 1, 1,
+                  "NHWC")                               # Cin % 64
+    assert not ok((2, 8, 8, 96), (3, 3, 96, 64), 1, 1, 1, 1,
+                  "NHWC")                               # Cin = 96
+    assert not ok((2, 8, 8, 64), (3, 3, 64, 96), 1, 1, 1, 1,
+                  "NHWC")                               # Cout = 96
+    # ResNet-50's whole 3×3 family tiles for both fwd and chain kernels
+    for hw, ch in ((56, 64), (28, 128), (14, 256), (7, 512)):
+        assert pallas_conv.fused_fwd_ok(hw, hw, ch, ch)
+        assert pallas_conv.fused_chain_ok(hw, hw, ch, ch)
+    assert not pallas_conv.fused_fwd_ok(224, 224, 256, 256)   # VMEM
+    assert not pallas_conv.fused_chain_ok(224, 224, 256, 256)
+
+
+def test_gemm_prologue_gate():
+    ok = nn_ops._gemm_prologue_ok
+    w1 = (1, 1, 48, 64)
+    z4 = (2, 8, 8, 48)
+    assert ok(z4, w1, 1, 0, 1, 1, "NHWC")       # no %64 rule: plain GEMM
+    assert ok(z4, w1, 1, "SAME", 1, 1, "NHWC")
+    assert ok(z4, w1, 1, [(0, 0), (0, 0)], 1, 1, "NHWC")
+    assert not ok(z4, w1, 2, 0, 1, 1, "NHWC")           # stride
+    assert not ok(z4, w1, 1, 1, 1, 1, "NHWC")           # pad
+    assert not ok(z4, w1, 1, 0, 1, 2, "NHWC")           # groups
+    assert not ok(z4, (3, 3, 48, 64), 1, 0, 1, 1, "NHWC")  # 3×3
+    assert not ok(z4, w1, 1, 0, 1, 1, "NCHW")           # layout
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 5, 7, 64, 64),      # odd H/W, the smallest fused channels
+    (1, 4, 4, 128, 64),     # Cin ≠ Cout, contracting
+    (2, 3, 3, 64, 128),     # expanding, spatial == kernel
+])
+@pytest.mark.parametrize("act", ["relu", ""])
+def test_fused_fwd_matches_reference(rng, shape, act):
+    n, h, w, cin, cout = shape
+    z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout)
+    assert pallas_conv.fusable_fwd((n, h, w, cin), (3, 3, cin, cout),
+                                   1, 1, 1, 1, "NHWC")
+    got = nn_ops.affine_act_conv2d(z, a, c, wt, act=act,
+                                   is_training=True, padding=1)
+    _assert_close(got, _fwd_reference(z, a, c, wt, act))
+
+
+def test_fused_fwd_gradients_match_reference(rng):
+    n, h, w, cin, cout = 2, 5, 7, 64, 64
+    z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout)
+    cb = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.1
+    cot = jnp.asarray(rng.randn(n, h, w, cout).astype(np.float32))
+
+    def loss_fused(z, a, c, wt, cb):
+        y = nn_ops.affine_act_conv2d(z, a, c, wt, conv_bias=cb,
+                                     is_training=True, padding=1)
+        return jnp.sum(y * cot)
+
+    def loss_ref(z, a, c, wt, cb):
+        return jnp.sum(_fwd_reference(z, a, c, wt, "relu", cb) * cot)
+
+    args = (z, a, c, wt, cb)
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(*args)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(*args)
+    for name, gf, gr in zip(["dz", "da", "dc", "dw", "dcb"],
+                            g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   err_msg=name, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("cin,cout", [(64, 64), (48, 96)])
+def test_fused_fwd_1x1_prologue_matches(rng, cin, cout):
+    """The 1×1 GEMM path accepts the affine+ReLU prologue with no
+    channel-tile rule (plain dot_general underneath) — fwd + grads."""
+    n, h, w = 2, 5, 5
+    z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout, kh=1)
+    assert nn_ops._gemm_prologue_ok((n, h, w, cin), (1, 1, cin, cout),
+                                    1, 0, 1, 1, "NHWC")
+    got = nn_ops.affine_act_conv2d(z, a, c, wt, is_training=True,
+                                   padding=0)
+    _assert_close(got, _fwd_reference(z, a, c, wt))
+    cot = jnp.asarray(rng.randn(n, h, w, cout).astype(np.float32))
+    g_fused = jax.grad(
+        lambda *ar: jnp.sum(nn_ops.affine_act_conv2d(
+            *ar, is_training=True, padding=0) * cot),
+        argnums=(0, 1, 2, 3))(z, a, c, wt)
+    g_ref = jax.grad(
+        lambda *ar: jnp.sum(_fwd_reference(*ar) * cot),
+        argnums=(0, 1, 2, 3))(z, a, c, wt)
+    for name, gf, gr in zip(["dz", "da", "dc", "dw"], g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   err_msg=name, rtol=3e-4, atol=3e-4)
+
+
+# ------------------------------------------- fwd gates → exact fallback
+@pytest.mark.parametrize("cin,cout", [(48, 64), (96, 96)])
+def test_fwd_edge_channels_fall_back_and_match(rng, cin, cout):
+    """Off-tile channels through the forward direction take the exact
+    unfused composition (and still match it)."""
+    n, h, w = 2, 5, 5
+    z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout)
+    assert not pallas_conv.fusable_fwd((n, h, w, cin), (3, 3, cin, cout),
+                                       1, 1, 1, 1, "NHWC")
+    got = nn_ops.affine_act_conv2d(z, a, c, wt, is_training=True,
+                                   padding=1)
+    _assert_close(got, _fwd_reference(z, a, c, wt))
+
+
+def test_fwd_eval_and_stride_fall_back_and_match(rng):
+    n, h, w, cin, cout = 2, 6, 6, 64, 64
+    z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout)
+    # eval mode: the exact composition even though the shapes tile
+    got = nn_ops.affine_act_conv2d(z, a, c, wt, is_training=False,
+                                   padding=1)
+    _assert_close(got, _fwd_reference(z, a, c, wt), rtol=1e-6, atol=1e-6)
+    # stride-2 never fuses (both kernel families are stride-1)
+    x = jax.nn.relu(z * a + c)
+    want = nn_ops.conv2d(x, wt, stride=2, padding=1)
+    got = nn_ops.affine_act_conv2d(z, a, c, wt, is_training=True,
+                                   stride=2, padding=1)
+    _assert_close(got, want)
+
+
+def test_chain_gate_misses_fall_back_and_match(rng):
+    """conv2d_bn with an input affine: eval mode and off-tile channels
+    materialize the affine exactly and continue as a plain pair — the
+    'both directions' gate contract."""
+    for cin, training in (((48), True), ((64), False)):
+        n, h, w, cout = 2, 5, 5, 64
+        z, a, c, wt = _fwd_inputs(rng, n, h, w, cin, cout)
+        cb = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.1
+        scale = jnp.asarray(rng.rand(cout).astype(np.float32) + 0.5)
+        bias = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.2
+        rm = jnp.asarray(rng.randn(cout).astype(np.float32)) * 0.1
+        rv = jnp.asarray(rng.rand(cout).astype(np.float32) + 0.5)
+        got = nn_ops.conv2d_bn(z, wt, cb, scale, bias, rm, rv, eps=EPS,
+                               is_training=training, padding=1,
+                               in_affine=(a, c, "relu"))
+        x = jax.nn.relu(z * a + c)
+        want = _reference(x, wt, cb, scale, bias, rm, rv,
+                          is_training=training)
+        for g, r in zip(got, want):
+            _assert_close(g, r)
+
+
+# ---------------------------------------------- fwd peephole + switches
+def _build_fwd_net(bn_act=None, filter_size=3, stride=1, padding=1,
+                   second_consumer=False, channels=64, out_is_bn=False):
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector
+    from paddle_tpu.layers.network import NeuralNetwork
+
+    img_sz = 6
+    with config_scope():
+        img = dsl.data("image", dense_vector(channels * img_sz * img_sz),
+                       height=img_sz, width=img_sz)
+        conv = dsl.img_conv(
+            img, filter_size=3, num_filters=channels, stride=1,
+            padding=1, num_channels=channels,
+            act=dsl.LinearActivation(), name="c1")
+        bn = dsl.batch_norm(conv, act=bn_act or dsl.ReluActivation(),
+                            name="bn1")
+        if out_is_bn:
+            return NeuralNetwork(dsl.topology(bn))
+        conv2 = dsl.img_conv(
+            bn, filter_size=filter_size, num_filters=channels,
+            stride=stride, padding=padding, num_channels=channels,
+            act=dsl.ReluActivation(), name="c2")
+        if second_consumer:
+            out = dsl.addto([conv2, bn], name="sum")
+            cfg = dsl.topology(out)
+        else:
+            cfg = dsl.topology(conv2)
+    return NeuralNetwork(cfg)
+
+
+def test_fwd_peephole_fires_on_intended_pattern():
+    from paddle_tpu.config.dsl import SigmoidActivation
+
+    assert _build_fwd_net()._bn_conv_fuse == {"c2": "bn1"}
+    # the 1×1 pointwise direction fires too
+    assert _build_fwd_net(filter_size=1, padding=0) \
+        ._bn_conv_fuse == {"c2": "bn1"}
+    # anything off-pattern must NOT fire
+    assert _build_fwd_net(stride=2)._bn_conv_fuse == {}
+    assert _build_fwd_net(filter_size=5, padding=2)._bn_conv_fuse == {}
+    assert _build_fwd_net(
+        bn_act=SigmoidActivation())._bn_conv_fuse == {}
+    # BN with a second consumer keeps its standalone value
+    assert _build_fwd_net(second_consumer=True)._bn_conv_fuse == {}
+    # BN as the network output is never deferred
+    assert _build_fwd_net(out_is_bn=True)._bn_conv_fuse == {}
+
+
+def test_fwd_kill_switch_restores_round6_lowering():
+    """--conv_bn_fuse_fwd=false must reproduce the exact round-6 maps:
+    no deferred BNs, and the conv→BN backward pairs reinstated."""
+    from paddle_tpu.utils import FLAGS
+
+    net = _build_fwd_net()
+    # fwd fusion claims bn1, which evicts the round-6 {bn1: c1} pair
+    assert net._bn_conv_fuse == {"c2": "bn1"}
+    assert net._conv_bn_fuse == {}
+    FLAGS.set("conv_bn_fuse_fwd", False)
+    try:
+        net = _build_fwd_net()
+        assert net._bn_conv_fuse == {}
+        assert net._conv_bn_fuse == {"bn1": "c1"}   # round 6 restored
+    finally:
+        FLAGS.set("conv_bn_fuse_fwd", True)
+    # and the round-6 switch composes: both off → nothing fuses
+    FLAGS.set("conv_bn_fuse", False)
+    FLAGS.set("conv_bn_fuse_fwd", False)
+    try:
+        net = _build_fwd_net()
+        assert net._bn_conv_fuse == {} and net._conv_bn_fuse == {}
+    finally:
+        FLAGS.set("conv_bn_fuse", True)
+        FLAGS.set("conv_bn_fuse_fwd", True)
+
+
+def test_fwd_peephole_network_matches_unfused(rng):
+    net = _build_fwd_net()
+    assert net._bn_conv_fuse == {"c2": "bn1"}
+    params = net.init_params(seed=1)
+    buffers = net.init_buffers()
+    feed = {"image": jnp.asarray(
+        rng.randn(4, 64 * 6 * 6).astype(np.float32))}
+
+    def run(params, fuse, training=True):
+        saved = net._bn_conv_fuse
+        net._bn_conv_fuse = saved if fuse else {}
+        try:
+            return net.forward(params, feed, dict(buffers),
+                               is_training=training)
+        finally:
+            net._bn_conv_fuse = saved
+
+    v1, b1 = run(params, True)
+    v0, b0 = run(params, False)
+    # the BN's applied value is fused away (a DeferredBN placeholder
+    # remains); outputs and running-stat updates are unchanged
+    from paddle_tpu.layers.conv import DeferredBN
+
+    assert isinstance(v1["bn1"], DeferredBN)
+    assert not isinstance(v0["bn1"], DeferredBN)
+    _assert_close(v1["c2"], v0["c2"])
+    for k in b0:
+        _assert_close(b1[k], b0[k])
+
+    def loss(params, fuse):
+        values, _ = run(params, fuse)
+        return jnp.sum(values["c2"] ** 2)
+
+    g1 = jax.grad(lambda p: loss(p, True))(params)
+    g0 = jax.grad(lambda p: loss(p, False))(params)
+    for k in sorted(g0):
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g0[k]),
+                                   err_msg=k, rtol=3e-4, atol=3e-4)
+
+    # eval mode: the forward falls back to the exact composition
+    v1, _ = run(params, True, training=False)
+    v0, _ = run(params, False, training=False)
+    _assert_close(v1["c2"], v0["c2"], rtol=1e-6, atol=1e-6)
