@@ -1,6 +1,6 @@
 """Headline benchmarks, matched to BASELINE.json's primary metrics.
 
-Five workloads (the first printed line is the driver-parsed metric):
+Six workloads (the first printed line is the driver-parsed metric):
 
 1. **LSTM text classifier** training ms/batch — the reference RNN
    benchmark (``benchmark/paddle/rnn/rnn.py`` via ``paddle train
@@ -20,10 +20,13 @@ Five workloads (the first printed line is the driver-parsed metric):
    kernel's product surface (``scaled_dot_product_attention`` layer);
    no reference yardstick exists (2017 codebase), MFU is the figure.
 5. **LSTM hidden=1280** ms/batch — the baseline's big-hidden row
-   (1007 ms on K40m, ``benchmark/README.md:124-126``).  H=1280 exceeds
-   the fused Pallas LSTM's VMEM gate (``ops/pallas_lstm.py``) and runs
-   the ``lax.scan`` path, so this row MEASURES the fallback gap the
-   gate used to hide (VERDICT missing #5).
+   (1007 ms on K40m, ``benchmark/README.md:124-126``).  Round 8's
+   hidden-blocked tier (``ops/pallas_lstm.py``) carries this row on
+   the fused path; every RNN line stamps the runtime-resolved
+   ``path`` (``fused_blocked|fused|scan``) so the artifact records
+   which tier actually ran.
+6. **LSTM hidden=2048** ms/batch — blocked-tier scaling row (no
+   published reference number; the K40m table stops at 1280).
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
 Adam).  Timing chains K steps inside one ``lax.scan`` program (see
@@ -248,15 +251,33 @@ def _bench_lstm_row(hidden, baseline_ms, metric, iters=256):
     # layer2 both projections from H; per timestep, ×T
     fwd = 2 * B * T * (E * 4 * H + H * 4 * H + H * 4 * H + H * 4 * H)
     mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
-    return _finish(_with_band({
+    r = {
         "metric": metric,
         "value": round(ms, 3),
         "unit": f"ms/batch (bs=128, hidden={H}, 2xLSTM, T=100)",
-        "vs_baseline": round(baseline_ms / ms, 3),
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
-    }), f"lstm{H}", trainer, feed)
+        "path": _rnn_path("lstm", B, H),
+    }
+    if baseline_ms is None:
+        r["vs_baseline_note"] = ("no published reference number at "
+                                 f"hidden={H}; the K40m table stops "
+                                 "at 1280")
+    else:
+        r["vs_baseline"] = round(baseline_ms / ms, 3)
+    return _finish(_with_band(r), f"lstm{H}", trainer, feed)
+
+
+def _rnn_path(kind, b, h):
+    """Runtime-resolved RNN lowering for a (batch, hidden) shape —
+    the SAME predicate ops/recurrent_ops.py dispatches on (it sees the
+    --fused_rnn_hblock kill switch), so the artifact records which
+    tier this process actually ran, not what a doc comment claims."""
+    from paddle_tpu.ops import pallas_gru, pallas_lstm
+
+    tier = (pallas_gru if kind == "gru" else pallas_lstm).fused_tier(b, h)
+    return tier or "scan"
 
 
 def bench_lstm():
@@ -264,14 +285,22 @@ def bench_lstm():
 
 
 def bench_lstm_1280():
-    """The baseline's hidden=1280/bs=128 row (1007 ms on K40m).  H=1280
-    is past the fused kernel's VMEM gate → lax.scan path (with the
-    one-time fallback warning from ops/recurrent_ops.py), so this row
-    measures the un-fused gap instead of silently hiding it."""
-    r = _bench_lstm_row(1280, 1007.0, "lstm_text_cls_1280_ms_per_batch",
-                        iters=64)
-    r["note"] = "H=1280 > fused-LSTM VMEM gate; measures the scan path"
-    return r
+    """The baseline's hidden=1280/bs=128 row (1007 ms on K40m) — the
+    round-8 hidden-blocked tier carries it on the fused path (the JSON
+    line's ``path`` field says which tier actually ran; with
+    ``--fused_rnn_hblock=false`` it reads ``scan`` and measures the
+    pre-blocking fallback gap)."""
+    return _bench_lstm_row(1280, 1007.0, "lstm_text_cls_1280_ms_per_batch",
+                           iters=64)
+
+
+def bench_lstm_2048():
+    """Blocked-tier scaling row: H=2048 doubles the streamed-weight
+    traffic per step vs 1280 while the [B, H] VMEM state stays cheap,
+    so ms/batch should scale with w_hh bytes — visible against the
+    1280 row in the same artifact."""
+    return _bench_lstm_row(2048, None, "lstm_text_cls_2048_ms_per_batch",
+                           iters=32)
 
 
 def _bench_resnet_once(extras=True):
@@ -444,6 +473,7 @@ def bench_seq2seq():
         "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
+        "path": _rnn_path("gru", B, H),
     }), "seq2seq", trainer, feed)
 
 
@@ -506,7 +536,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     choices=["lstm", "resnet", "seq2seq", "attention",
-                             "lstm1280"])
+                             "lstm1280", "lstm2048"])
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace of a few production "
                          "train steps per workload (see --profile_dir); "
@@ -514,15 +544,19 @@ def main():
                          "line as trace_dir")
     ap.add_argument("--profile_dir", default="./profiles",
                     help="root directory for --profile trace dumps")
-    args = ap.parse_args()
+    # framework flags ride the same CLI (e.g. --fused_rnn_hblock=false
+    # for an A/B of the blocked RNN tier against the scan path)
+    import sys
+    args = ap.parse_args(FLAGS.parse_argv(sys.argv[1:]))
     if args.profile:
         global PROFILE_DIR
         PROFILE_DIR = args.profile_dir
     benches = {"lstm": bench_lstm, "resnet": bench_resnet,
                "seq2seq": bench_seq2seq, "attention": bench_attention,
-               "lstm1280": bench_lstm_1280}
+               "lstm1280": bench_lstm_1280, "lstm2048": bench_lstm_2048}
     order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
-                                           "attention", "lstm1280"]
+                                           "attention", "lstm1280",
+                                           "lstm2048"]
     for name in order:
         try:
             print(json.dumps(benches[name]()), flush=True)

@@ -128,6 +128,13 @@ FLAGS.define("conv_bn_fuse_fwd", True,
              "instead of materializing the normalized activation in "
              "HBM; off = the exact round-6 lowering, for A/B traffic "
              "measurement")
+FLAGS.define("fused_rnn_hblock", True,
+             "enable the hidden-blocked fused RNN tier (ops/"
+             "pallas_lstm.py, ops/pallas_gru.py): 512 < H shapes run "
+             "the whole-sequence Pallas kernels with w_hh streamed as "
+             "[H, gates*128] column blocks instead of falling back to "
+             "lax.scan; off = the round-7 H<=512 gate, for one-flag "
+             "revert / A/B measurement")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

@@ -63,6 +63,13 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     tr.section("FAST-LANE BUDGET EXCEEDED", sep="=", red=True, bold=True)
     tr.line(f"the default quick lane (-m 'not slow') took {elapsed:.0f} s "
             f"> {FAST_LANE_BUDGET_S} s budget (round-6 reference: 278 s).")
+    # name the offenders: the three slowest call phases, so the breach
+    # points at the tests to mark slow instead of just announcing itself
+    reports = [r for key in ("passed", "failed")
+               for r in tr.stats.get(key, ())
+               if getattr(r, "when", None) == "call"]
+    for r in sorted(reports, key=lambda r: r.duration, reverse=True)[:3]:
+        tr.line(f"  slowest: {r.duration:7.1f} s  {r.nodeid}")
     tr.line("Move heavyweight tests to @pytest.mark.slow or speed them "
             "up; set PADDLE_TPU_FAST_LANE_STRICT=1 to make this fail.")
 

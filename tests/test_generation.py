@@ -63,7 +63,12 @@ def test_beam_scores_sorted_and_shapes():
 
 def test_beam1_matches_greedy_hand_rollout():
     """beam_size=1 must equal a hand-rolled greedy decode using the same
-    parameters (numpy reference implementation)."""
+    parameters (numpy reference implementation).  Greedy decoding stops
+    at EOS: the machine freezes a finished beam (its only continuation
+    is EOS at zero cost — see BeamSearchDecoder), so the reference must
+    freeze too — a rollout that keeps feeding tokens past EOS is
+    decoding a different problem, which is exactly the bug this test
+    shipped with (it failed on the seed whenever a row hit EOS early)."""
     cfg, gen = _gen_topology(beam_size=1, max_length=5)
     net = NeuralNetwork(cfg)
     params = {k: np.asarray(v) for k, v in net.init_params(seed=3).items()}
@@ -73,8 +78,9 @@ def test_beam1_matches_greedy_hand_rollout():
                             {"src": jnp.asarray(src)}, {},
                             is_training=False)
     got = np.asarray(values[gen.name])[:, 0, :]   # [B, T]
+    lengths = np.asarray(values[f"{gen.name}.lengths"])[:, 0]
 
-    # ---- numpy greedy reference
+    # ---- numpy greedy reference (EOS freezes a row, pads with EOS)
     def fc(x, w, b=None):
         y = x @ w
         return y + b if b is not None else y
@@ -82,6 +88,7 @@ def test_beam1_matches_greedy_hand_rollout():
     emb_t = params["_trg_emb"]
     state = enc
     ids = np.full((3,), BOS, np.int64)
+    finished = np.zeros((3,), bool)
     ref = []
     for _ in range(5):
         e = emb_t[ids]
@@ -89,11 +96,16 @@ def test_beam1_matches_greedy_hand_rollout():
                     + state @ params["_dec_state.w1"]
                     + params["_dec_state.wbias"])
         logits = h @ params["_dec_prob.w0"] + params["_dec_prob.wbias"]
-        ids = logits.argmax(-1)
+        ids = np.where(finished, EOS, logits.argmax(-1))
         ref.append(ids)
+        finished |= ids == EOS
         state = h
     ref = np.stack(ref, axis=1)
     np.testing.assert_array_equal(got, ref)
+    # reported lengths agree with the reference's first EOS
+    is_eos = ref == EOS
+    ref_len = np.where(is_eos.any(1), is_eos.argmax(1) + 1, 5)
+    np.testing.assert_array_equal(lengths, ref_len)
 
 
 @pytest.mark.slow  # heavyweight e2e; fast lane skips (--runslow)
