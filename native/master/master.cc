@@ -201,10 +201,16 @@ class MasterService {
     return epoch_;
   }
 
-  // save-model election (one trainer wins per interval)
+  // save-model election (one trainer wins per interval); interval_s < 0
+  // is a RELEASE: the owner whose save failed gives the window back so a
+  // healthy peer can win it instead of the fleet losing the checkpoint
   int RequestSaveModel(const std::string& trainer_id, double interval_s) {
     std::lock_guard<std::mutex> g(mu_);
     auto now = Clock::now();
+    if (interval_s < 0) {
+      if (save_owner_ == trainer_id) save_owner_.clear();
+      return 0;
+    }
     if (save_owner_.empty() || now >= save_expiry_) {
       save_owner_ = trainer_id;
       save_expiry_ = now + std::chrono::duration_cast<Clock::duration>(
@@ -355,8 +361,15 @@ class MasterService {
 // SET\t<p1>\x1f<p2>...    -> OK
 // RESET[\t<epoch>]        -> OK    (epoch = pass-number handshake)
 // EPOCH                   -> <current epoch number>
-// SAVE\t<trainer>\t<sec>  -> 1 | 0
+// SAVE\t<trainer>\t<sec>  -> 1 | 0   (sec < 0: owner releases the window)
 // COUNTS                  -> <todo>\t<pending>\t<done>\t<failed>
+// PING                    -> PONG  (liveness probe, no state touched)
+//
+// Every request gets exactly one response line; a malformed request gets
+// ERR and the connection stays usable.  Reconnecting clients may replay
+// any request after a re-dial — every op is replay-safe (GET's lost
+// lease times out, SET is first-wins, the rest are idempotent) — and
+// PING gives them a cheap probe that touches no state.
 std::string MasterService::HandleLine(const std::string& line) {
   try {
     return HandleLineImpl(line);
@@ -410,6 +423,9 @@ std::string MasterService::HandleLineImpl(const std::string& line) {
   if (cmd == "EPOCH") {
     return std::to_string(Epoch());
   }
+  if (cmd == "PING") {
+    return "PONG";
+  }
   if (cmd == "COUNTS") {
     int a, b, c, d;
     Counts(&a, &b, &c, &d);
@@ -440,6 +456,10 @@ void MasterService::ServerLoop() {
         }
         active_conns_--;
       };
+      // a peer that streams bytes without ever framing a line (fuzzed
+      // input, a non-protocol client) must not grow the buffer without
+      // bound or wedge the handler — drop the connection instead
+      constexpr size_t kMaxLine = 1 << 24;  // 16 MB; SET of a big dataset
       std::string buf;
       char chunk[4096];
       while (serving_) {
@@ -461,6 +481,11 @@ void MasterService::ServerLoop() {
             }
             off += w;
           }
+        }
+        // after the drain loop buf is provably newline-free, so the
+        // flood check is O(1): no rescan of the whole buffer per read
+        if (buf.size() > kMaxLine) {
+          break;  // unframed flood: close, the client re-dials cleanly
         }
       }
       done();

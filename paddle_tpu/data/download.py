@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import hashlib
 import os
+import random
 import shutil
+import time
 import urllib.request
 
 from ..utils import get_logger
@@ -45,21 +47,31 @@ def cached_path(url: str, module_name: str) -> str:
 
 
 def download(url: str, module_name: str, md5sum: str,
-             retry_limit: int = 3) -> str:
+             retry_limit: int = 3, backoff_base_s: float = 0.5) -> str:
     """Return the local path of ``url``, downloading + md5-verifying into
     the cache if needed (``common.py:62`` semantics, including the retry
-    loop)."""
+    loop).
+
+    A transient ``OSError`` (connection reset, timeout, DNS blip, 5xx)
+    consumes one retry and backs off exponentially with jitter;
+    :class:`DownloadError` is raised only once ``retry_limit`` attempts
+    are exhausted.  A permanent HTTP client error (4xx — the URL is
+    wrong, not the network) fails fast without burning retries.
+    """
     filename = cached_path(url, module_name)
     os.makedirs(os.path.dirname(filename), exist_ok=True)
     retry = 0
+    last_err = None
     while not (os.path.exists(filename) and md5file(filename) == md5sum):
         if os.environ.get("PADDLE_TPU_NO_DOWNLOAD"):
             raise DownloadError(
                 f"{filename} not cached and downloads are disabled "
                 "(PADDLE_TPU_NO_DOWNLOAD)")
         if retry >= retry_limit:
+            detail = f" (last error: {last_err})" if last_err else ""
             raise DownloadError(
-                f"cannot download {url} within {retry_limit} retries")
+                f"cannot download {url} within {retry_limit} "
+                f"retries{detail}")
         retry += 1
         log.info("cache miss for %s, downloading %s (try %d)",
                  filename, url, retry)
@@ -70,9 +82,25 @@ def download(url: str, module_name: str, md5sum: str,
                 shutil.copyfileobj(r, f)
             os.replace(tmp, filename)
         except OSError as e:
+            last_err = e
             if os.path.exists(tmp):
                 os.remove(tmp)
-            raise DownloadError(f"download of {url} failed: {e}") from e
+            code = getattr(e, "code", None)  # urllib HTTPError status
+            # 408 (request timeout) and 429 (rate limited) are transient
+            # despite being 4xx — they are exactly what backoff is for
+            if code is not None and 400 <= code < 500 \
+                    and code not in (408, 429):
+                raise DownloadError(
+                    f"download of {url} failed permanently "
+                    f"(HTTP {code}): {e}") from e
+            if retry >= retry_limit:
+                continue  # the loop head raises with this error attached
+            delay = backoff_base_s * (2 ** (retry - 1))
+            delay *= 0.5 + random.random()  # jitter: [0.5, 1.5)x
+            log.warning("download of %s failed (%s: %s); retry %d/%d "
+                        "in %.1fs", url, type(e).__name__, e, retry,
+                        retry_limit, delay)
+            time.sleep(delay)
     return filename
 
 

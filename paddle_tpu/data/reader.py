@@ -269,7 +269,9 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
         for rec in rio.read_chunk(path, int(off)):
             yield pickle.loads(rec)
 
-    inner = master_reader(master, load_chunk)
+    # the shared client outlives each pass's generator: don't let
+    # master_reader's teardown close it between passes
+    inner = master_reader(master, load_chunk, close_client=False)
     # offset the local pass counter by the master's epoch so a trainer
     # (re)joining a long-lived or snapshot-recovered master doesn't send
     # reset requests the master has already performed

@@ -1,11 +1,14 @@
 """Distributed runtime: the gen-2 (Go master/pserver) equivalents.
 
 - :mod:`master`: C++ data-task service (leases, failure re-queue,
-  snapshot/recover, save-model election) over ctypes, plus a TCP client —
-  replaces ``go/master`` + etcd.
+  snapshot/recover, save-model election, PING liveness) over ctypes,
+  plus a reconnecting TCP client (backoff + request replay,
+  ``--master_retry_max``) — replaces ``go/master`` + etcd.
 - :mod:`elastic`: preemption-tolerant checkpointed training loop —
   replaces the stateless-trainer + checkpointing pserver story
-  (``doc/design/cluster_train/README.md``).
+  (``doc/design/cluster_train/README.md``); recovery paths are verified
+  by fault injection (``paddle_tpu/testing/fault.py``,
+  ``tests/test_chaos.py``).
 
 The parameter-server *gradient* path has no equivalent by design: gradient
 exchange is ICI all-reduce inside the jitted train step (SURVEY §2.5 →

@@ -27,17 +27,21 @@ class ElasticTrainer:
 
     def __init__(self, trainer: Trainer, client, load_fn: Callable,
                  save_dir: str, trainer_id: str = "trainer-0",
-                 checkpoint_every_s: float = 60.0):
+                 checkpoint_every_s: float = 60.0,
+                 ckpt_fail_max: int = 3):
         self.trainer = trainer
         self.client = client
         self.load_fn = load_fn
         self.save_dir = save_dir
         self.trainer_id = trainer_id
         self.checkpoint_every_s = checkpoint_every_s
+        self.ckpt_fail_max = ckpt_fail_max
         self._last_ckpt = 0.0
+        self._ckpt_failures = 0  # consecutive save failures
 
     def resume(self) -> bool:
-        """Load the latest checkpoint if one exists."""
+        """Load the newest *valid* checkpoint if one exists (corrupt
+        dirs are skipped + quarantined by ``Trainer.resume``)."""
         ok = self.trainer.resume(self.save_dir)
         if ok:
             log.info("resumed from checkpoint in %s "
@@ -50,10 +54,36 @@ class ElasticTrainer:
         if not force and now - self._last_ckpt < self.checkpoint_every_s:
             return
         # save-model election: exactly one trainer checkpoints per window
-        if self.client.request_save_model(self.trainer_id,
-                                          self.checkpoint_every_s):
+        if not self.client.request_save_model(self.trainer_id,
+                                              self.checkpoint_every_s):
+            return
+        try:
             self.trainer.save(self.save_dir, epoch)
-            self._last_ckpt = now
+        except OSError as e:
+            # a transient disk fault (ENOSPC, EACCES, ...) must not kill
+            # the training loop: skip this window, retry at the next one.
+            # Only an epoch-end force save escalates, and only once the
+            # disk has been bad for ckpt_fail_max consecutive attempts —
+            # at that point progress durability is genuinely gone.
+            self._ckpt_failures += 1
+            try:
+                # release the won election (interval < 0) so a healthy
+                # peer can checkpoint this window instead of the fleet
+                # silently losing it to our broken disk
+                self.client.request_save_model(self.trainer_id, -1.0)
+            except Exception:  # noqa: BLE001 — best-effort release
+                pass
+            log.warning(
+                "checkpoint save failed: epoch=%d force=%s dir=%s "
+                "consecutive=%d/%d error=%s: %s — skipping this window",
+                epoch, force, self.save_dir, self._ckpt_failures,
+                self.ckpt_fail_max, type(e).__name__, e)
+            if force and self._ckpt_failures >= self.ckpt_fail_max:
+                raise
+            self._last_ckpt = now  # full window before the next attempt
+            return
+        self._ckpt_failures = 0
+        self._last_ckpt = now
 
     def _train_batch(self, feeder, samples, epoch: int,
                      event_handler: Optional[Callable]) -> None:

@@ -10,12 +10,16 @@ Two transports, mirroring the reference's two paths:
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
+import random
 import socket
 import subprocess
+import time
+import zlib
 from typing import List, Optional, Sequence, Tuple
 
-from ..utils import PaddleTpuError, enforce, get_logger
+from ..utils import FLAGS, PaddleTpuError, enforce, get_logger
 
 log = get_logger("master")
 
@@ -23,15 +27,25 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE = os.path.join(_REPO, "native")
 _SO = os.path.join(_NATIVE, "build", "libptpu_master.so")
+_CC = os.path.join(_NATIVE, "master", "master.cc")
 
 _lib = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    try:  # stale .so from an older source tree: rebuild
+        return os.path.getmtime(_SO) < os.path.getmtime(_CC)
+    except OSError:
+        return False
 
 
 def _load_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO):
+    if _needs_build():
         log.info("building native master library…")
         subprocess.run(["make", "-C", _NATIVE], check=True,
                        capture_output=True)
@@ -153,6 +167,9 @@ def _escape_payload(s: str) -> str:
 
 _HEX = set("0123456789abcdefABCDEF")
 
+# distinct jitter streams for clients created in the same process
+_client_nonce = itertools.count()
+
 
 def _unescape_payload(s: str) -> str:
     out = []
@@ -171,23 +188,101 @@ def _unescape_payload(s: str) -> str:
 
 
 class MasterClient:
-    """TCP client speaking the master's line protocol (remote trainers)."""
+    """TCP client speaking the master's line protocol (remote trainers).
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    Connection loss mid-call is survived, not fatal: ``_call`` re-dials
+    with exponential backoff + jitter and replays the request up to
+    ``retry_max`` times (default ``--master_retry_max``).  Replay is safe
+    for every op in the protocol: a GET whose response was lost leaves a
+    granted-but-unheard lease that times out server-side and re-queues
+    (at-least-once); SET is first-wins; FIN/FAIL on an unknown lease and
+    duplicate RESET/SAVE are no-ops.  ``retry_max=0`` restores the
+    legacy fail-fast contract — the first drop raises
+    ``PaddleTpuError("master connection closed")``.
+    """
+
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 retry_max: Optional[int] = None,
+                 retry_base_s: float = 0.05, retry_cap_s: float = 2.0):
         host, port = addr.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._retry_max = (FLAGS.master_retry_max if retry_max is None
+                           else retry_max)
+        self._retry_base_s = retry_base_s
+        self._retry_cap_s = retry_cap_s
+        # jitter spread: every client of one master must NOT share a
+        # backoff sequence or a master restart gets a reconnect stampede
+        # in lockstep — mix a per-process/per-client nonce into the seed
+        # (chaos tests stay deterministic via call-count triggers, not
+        # jitter values)
+        self._rng = random.Random(
+            zlib.crc32(addr.encode()) ^ (os.getpid() << 16)
+            ^ next(_client_nonce))
         self._buf = b""
+        self._closed = False
+        # the initial dial keeps today's fail-fast semantics: a wrong
+        # address should error immediately, not burn a retry budget
+        self._sock: Optional[socket.socket] = socket.create_connection(
+            self._addr, timeout=timeout)
 
-    def _call(self, line: str) -> str:
-        self._sock.sendall(line.encode() + b"\n")
-        while b"\n" not in self._buf:
-            chunk = self._sock.recv(4096)
-            if not chunk:
-                raise PaddleTpuError("master connection closed")
-            self._buf += chunk
-        resp, self._buf = self._buf.split(b"\n", 1)
-        return resp.decode()
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._buf = b""  # a partial response from the dead socket is junk
+
+    def _call(self, line: str, retry_override: Optional[int] = None) -> str:
+        if self._closed:
+            raise PaddleTpuError("master client is closed")
+        retry_max = (self._retry_max if retry_override is None
+                     else retry_override)
+        attempt = 0
+        while True:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self._addr, timeout=self._timeout)
+                    self._buf = b""
+                self._sock.sendall(line.encode() + b"\n")
+                while b"\n" not in self._buf:
+                    chunk = self._sock.recv(4096)
+                    if not chunk:
+                        raise ConnectionResetError(
+                            "master closed the connection")
+                    self._buf += chunk
+                resp, self._buf = self._buf.split(b"\n", 1)
+                return resp.decode()
+            except OSError as e:  # incl. ConnectionError, socket.timeout
+                self._drop_sock()
+                if attempt >= retry_max:
+                    raise PaddleTpuError("master connection closed") from e
+                delay = min(self._retry_cap_s,
+                            self._retry_base_s * (2 ** attempt))
+                delay *= 0.5 + self._rng.random()  # jitter: [0.5, 1.5)x
+                attempt += 1
+                log.warning(
+                    "master call %s failed (%s: %s); reconnect attempt "
+                    "%d/%d in %.2fs", line.split("\t", 1)[0],
+                    type(e).__name__, e, attempt, retry_max, delay)
+                time.sleep(delay)
+
+    def ping(self) -> bool:
+        """Cheap liveness probe (PING op; no master state touched).
+
+        A probe must answer fast, not block through the full reconnect
+        budget: at most one re-dial (to shed a dead cached socket), so
+        a down master yields False in ~one connect timeout.
+        """
+        try:
+            return self._call("PING",
+                              retry_override=min(self._retry_max, 1)) \
+                == "PONG"
+        except PaddleTpuError:
+            return False
 
     def set_dataset(self, payloads: Sequence[str]) -> None:
         self._call("SET\t" + "\x1f".join(_escape_payload(p)
@@ -225,10 +320,19 @@ class MasterClient:
         return dict(zip(("todo", "pending", "done", "failed"), vals))
 
     def close(self) -> None:
-        self._sock.close()
+        """Idempotent: safe to call any number of times."""
+        self._closed = True
+        self._drop_sock()
+
+    def __enter__(self) -> "MasterClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
-def master_reader(client, load_fn, wait_sleep: float = 0.05):
+def master_reader(client, load_fn, wait_sleep: float = 0.05,
+                  close_client: bool = True):
     """Reader pulling task payloads from a master and yielding samples —
     the ``cloud_reader`` equivalent (``python/paddle/v2/reader/creator.py:91``).
 
@@ -236,23 +340,50 @@ def master_reader(client, load_fn, wait_sleep: float = 0.05):
     only after its samples were fully consumed, failed if ``load_fn``
     raises — so a dead trainer's lease times out and the shard is re-done
     elsewhere (fault tolerance, ``go/master/service.go:313``).
+
+    When the generator is torn down *abandoned* — ``close()``d or
+    garbage-collected mid-pass (GeneratorExit) — the in-flight lease is
+    FAILed (immediate re-queue; peers must not WAIT out a dead lease's
+    full timeout) and the client's ``close()`` is called when it has
+    one, so a dropped reader never leaks its master socket.  Normal
+    exhaustion and escaping load faults leave the client open: the
+    returned reader is re-invocable (one call per pass) and
+    poison-shard retry loops re-enter it.  Pass ``close_client=False``
+    for a shared client whose lifecycle is managed elsewhere (e.g.
+    ``cloud_reader``'s multi-pass wrapper — the lease FAIL on
+    abandonment still happens).
     """
-    import time
 
     def reader():
-        while True:
-            tid, payload = client.get_task()
-            if payload is None:
-                if tid == 1:           # all leased elsewhere: wait
-                    time.sleep(wait_sleep)
-                    continue
-                break                   # epoch done
+        open_tid = None                    # leased, not yet FIN/FAILed
+        try:
+            while True:
+                tid, payload = client.get_task()
+                if payload is None:
+                    if tid == 1:           # all leased elsewhere: wait
+                        time.sleep(wait_sleep)
+                        continue
+                    break                   # epoch done
+                open_tid = tid
+                try:
+                    for sample in load_fn(payload):
+                        yield sample
+                except Exception:
+                    open_tid = None
+                    client.task_failed(tid)
+                    raise
+                open_tid = None
+                client.task_finished(tid)
+        except GeneratorExit:
             try:
-                for sample in load_fn(payload):
-                    yield sample
-            except Exception:
-                client.task_failed(tid)
-                raise
-            client.task_finished(tid)
+                if open_tid is not None:   # re-queue the abandoned shard
+                    client.task_failed(open_tid)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            if close_client:
+                close = getattr(client, "close", None)
+                if close is not None:
+                    close()
+            raise
 
     return reader

@@ -1,0 +1,12 @@
+"""Test-support utilities shipped with the package.
+
+:mod:`fault` is the chaos-engineering toolkit: composable fault
+injectors (dropped master connections, killed master processes,
+poisoned shards, corrupted checkpoints, failing saves) used by
+``tests/test_chaos.py`` to *prove* the elastic-training recovery paths
+instead of assuming them.
+"""
+
+from . import fault  # noqa: F401
+
+__all__ = ["fault"]

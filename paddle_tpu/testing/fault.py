@@ -1,0 +1,257 @@
+"""Composable fault injectors for chaos-testing the elastic path.
+
+Production TPU fleets are preemption-driven, so every recovery path in
+this repo is exercised by an injected fault rather than assumed to work
+(the verification spine of the robustness pass).  Injectors here are
+deterministic — they fire on call counts or explicit triggers, never on
+wall-clock or RNG draws — so chaos tests stay reproducible:
+
+- :func:`drop_master_connection` — sever a ``MasterClient``'s TCP socket
+  before (request lost) or after (response lost → granted-but-unheard
+  lease) every Nth call.
+- :class:`MasterServerProcess` — the TCP master in a child process that
+  can be SIGKILLed and restarted from its snapshot on the same port.
+- :func:`poison_load_fn` — raise inside ``load_fn`` on chosen shards a
+  bounded number of times.
+- :func:`corrupt_checkpoint` — truncate or bit-flip a checkpoint file.
+- :func:`failing_saves` — make ``trainer.save`` raise a disk-full
+  ``OSError`` for the next N calls.
+
+Everything is loopback/local-fs only; no real network is ever touched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Iterable, Optional
+
+from ..utils import get_logger
+
+log = get_logger("fault")
+
+
+# --------------------------------------------------------- TCP faults
+def _kill_socket(sock: Optional[socket.socket]) -> None:
+    """Hard-sever a socket: subsequent send/recv on it raise OSError."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def drop_master_connection(client, every: int = 3, limit: Optional[int] = None,
+                           when: str = "request"):
+    """Sever ``client``'s TCP connection around every ``every``-th call.
+
+    ``when="request"`` kills the socket *before* the request is sent (the
+    request is lost; replay is trivially safe).  ``when="response"``
+    first pushes the request bytes to the master, then kills the socket
+    (the master processes it but the response is lost — for GET this
+    manufactures a granted-but-unheard lease that must time out and
+    re-queue server-side).  ``limit`` bounds the number of injected
+    drops.  Yields a stats dict: ``{"calls": n, "dropped": n}``.
+    """
+    orig = client._call
+    stats = {"calls": 0, "dropped": 0}
+
+    def faulty_call(line: str, **kw) -> str:
+        stats["calls"] += 1
+        if stats["calls"] % every == 0 and \
+                (limit is None or stats["dropped"] < limit):
+            stats["dropped"] += 1
+            if when == "response" and client._sock is not None:
+                try:
+                    client._sock.sendall(line.encode() + b"\n")
+                except OSError:
+                    pass
+            _kill_socket(client._sock)
+            log.info("injected connection drop #%d (%s) before %r",
+                     stats["dropped"], when, line.split("\t", 1)[0])
+        return orig(line, **kw)
+
+    client._call = faulty_call
+    try:
+        yield stats
+    finally:
+        client._call = orig
+
+
+# --------------------------------------------------- master processes
+# The child runs the C++ service via ctypes directly — no paddle_tpu /
+# jax import, so spawn is fast and a SIGKILL cannot corrupt anything
+# but the master's own snapshot (which is what we are testing).
+_SERVER_SCRIPT = r"""
+import ctypes, sys, time
+so, snap, port, timeout_s, failure_max = sys.argv[1:6]
+lib = ctypes.CDLL(so)
+lib.ptpu_master_create.restype = ctypes.c_void_p
+lib.ptpu_master_create.argtypes = [
+    ctypes.c_double, ctypes.c_int, ctypes.c_char_p]
+lib.ptpu_master_serve.restype = ctypes.c_int
+lib.ptpu_master_serve.argtypes = [
+    ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+h = lib.ptpu_master_create(float(timeout_s), int(failure_max),
+                           snap.encode() if snap else None)
+p = lib.ptpu_master_serve(h, int(port), 0)
+print(p, flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+
+class MasterServerProcess:
+    """A TCP master service in a SIGKILL-able child process.
+
+    ``start()`` binds (remembering the port so a restart reuses it, which
+    keeps the client's address stable across kills), ``kill()`` sends
+    SIGKILL — no shutdown hooks run, exactly like a preempted VM — and a
+    later ``start()`` recovers from the snapshot path.
+    """
+
+    def __init__(self, snapshot_path: str, timeout_s: float = 5.0,
+                 failure_max: int = 3, port: int = 0):
+        from ..distributed.master import _SO, _load_lib
+        _load_lib()  # ensure the .so is built before the child needs it
+        self._so = _SO
+        self.snapshot_path = snapshot_path
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.port = port
+        self.proc: Optional[subprocess.Popen] = None
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self, wait_ready_s: float = 10.0) -> "MasterServerProcess":
+        assert self.proc is None or self.proc.poll() is not None, \
+            "master process already running"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVER_SCRIPT, self._so,
+             self.snapshot_path, str(self.port), str(self.timeout_s),
+             str(self.failure_max)],
+            stdout=subprocess.PIPE, text=True)
+        port = int(self.proc.stdout.readline())
+        assert port > 0, "master serve failed in child"
+        self.port = port
+        self._wait_ready(wait_ready_s)
+        return self
+
+    def _wait_ready(self, budget_s: float) -> None:
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", self.port),
+                                              timeout=1.0) as s:
+                    s.sendall(b"PING\n")
+                    if s.recv(64).startswith(b"PONG"):
+                        return
+            except OSError:
+                time.sleep(0.02)
+        raise TimeoutError("master child never answered PING")
+
+    def kill(self) -> None:
+        """SIGKILL — the preemption model: no cleanup code runs."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+    def __enter__(self) -> "MasterServerProcess":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.kill()
+
+
+# ------------------------------------------------------- data faults
+class ShardFault(RuntimeError):
+    """Raised by a poisoned ``load_fn`` (distinct type so tests can
+    assert the fault propagated through the right path)."""
+
+
+def poison_load_fn(load_fn: Callable, bad_payloads: Iterable[str],
+                   times: int = 1) -> Callable:
+    """Wrap ``load_fn`` to raise :class:`ShardFault` the first ``times``
+    times each payload in ``bad_payloads`` is loaded; later attempts
+    pass through (a transiently bad shard).  ``times < 0`` poisons the
+    shard permanently."""
+    bad = set(bad_payloads)
+    hits: dict = {}
+
+    def wrapped(payload):
+        if payload in bad:
+            n = hits.get(payload, 0)
+            if times < 0 or n < times:
+                hits[payload] = n + 1
+                raise ShardFault(
+                    f"injected shard fault on {payload!r} (hit {n + 1})")
+        return load_fn(payload)
+
+    wrapped.hits = hits
+    return wrapped
+
+
+# ------------------------------------------------- checkpoint faults
+def corrupt_checkpoint(ckpt_dir: str, fname: str = "params.npz",
+                       mode: str = "truncate") -> str:
+    """Damage one file of a checkpoint dir in place.
+
+    ``mode="truncate"`` chops the file to half its size (a torn write /
+    partial flush); ``mode="bitflip"`` XOR-flips one byte in the middle
+    (silent media corruption — the case only digests can catch).
+    Returns the damaged path.
+    """
+    path = os.path.join(ckpt_dir, fname)
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    elif mode == "bitflip":
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    log.info("injected %s corruption into %s", mode, path)
+    return path
+
+
+@contextlib.contextmanager
+def failing_saves(trainer, times: int = 1,
+                  exc: Optional[OSError] = None):
+    """Make ``trainer.save`` raise a disk-full ``OSError`` for the next
+    ``times`` calls (``times < 0``: every call), then pass through.
+    Yields a stats dict ``{"failed": n, "succeeded": n}``."""
+    orig = trainer.save
+    stats = {"failed": 0, "succeeded": 0}
+
+    def faulty_save(save_dir, pass_id):
+        if times < 0 or stats["failed"] < times:
+            stats["failed"] += 1
+            raise exc or OSError(errno.ENOSPC,
+                                 "injected: no space left on device")
+        out = orig(save_dir, pass_id)
+        stats["succeeded"] += 1
+        return out
+
+    trainer.save = faulty_save
+    try:
+        yield stats
+    finally:
+        trainer.save = orig

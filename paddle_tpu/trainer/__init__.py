@@ -1,10 +1,13 @@
 from . import events
 from .checkpoint import (
     latest_checkpoint,
+    latest_valid_checkpoint,
     load_buffers,
     load_opt_state,
     load_params,
     save_checkpoint,
+    sweep_retention,
+    verify_checkpoint,
 )
 from .trainer import Trainer, optimizer_from_config
 
@@ -12,9 +15,12 @@ __all__ = [
     "Trainer",
     "events",
     "latest_checkpoint",
+    "latest_valid_checkpoint",
     "load_buffers",
     "load_opt_state",
     "load_params",
     "optimizer_from_config",
     "save_checkpoint",
+    "sweep_retention",
+    "verify_checkpoint",
 ]

@@ -7,20 +7,29 @@ are checkpointed too** (the reference only does this in the Go pserver,
 ``go/pserver/service.go:146``).  Format: one ``.npz`` per state collection +
 a JSON manifest with step counters and config digest, written atomically so
 a preempted TPU job never sees a torn checkpoint.
+
+Integrity + retention (robustness pass): the manifest records a SHA-256
+digest and byte size per file; :func:`verify_checkpoint` re-checks them,
+:func:`latest_valid_checkpoint` scans backward past corrupt/torn dirs
+(quarantining them as ``.corrupt-*`` so the scan never re-reads them),
+and :func:`sweep_retention` keeps the newest ``--ckpt_keep`` dirs after
+each save.  ``--ckpt_verify=false`` restores the legacy blind load.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
-from ..utils import PaddleTpuError, get_logger
+from ..utils import FLAGS, PaddleTpuError, get_logger
 
 log = get_logger("checkpoint")
 
@@ -33,10 +42,24 @@ def _flatten_state(tree) -> Dict[str, np.ndarray]:
     return flat, treedef
 
 
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
                     opt_state: Any = None, buffers: Optional[Dict] = None,
-                    meta: Optional[Dict] = None) -> str:
-    """Write ``<save_dir>/pass-%05d`` atomically; returns the dir path."""
+                    meta: Optional[Dict] = None,
+                    keep: Optional[int] = None) -> str:
+    """Write ``<save_dir>/pass-%05d`` atomically; returns the dir path.
+
+    The manifest carries per-file SHA-256 digests (``files``) so loaders
+    can detect bit-flips/truncation, and a successful save sweeps
+    retention (keep the newest ``keep`` dirs, default ``--ckpt_keep``).
+    """
     final = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(save_dir, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-ckpt-")
@@ -46,11 +69,20 @@ def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
         if buffers:
             np.savez(os.path.join(tmp, "buffers.npz"),
                      **{k: np.asarray(v) for k, v in buffers.items()})
-        manifest = {"pass_id": pass_id, "format": 1, **(meta or {})}
+        manifest = {"pass_id": pass_id, "format": 2, **(meta or {})}
         if opt_state is not None:
             flat, treedef = _flatten_state(opt_state)
             np.savez(os.path.join(tmp, "opt_state.npz"), **flat)
             manifest["opt_treedef"] = str(treedef)
+        # digest every data file; the manifest is written LAST so its
+        # presence certifies the .npz files were fully flushed.  The
+        # --ckpt_verify kill switch disables the save-side hashing cost
+        # too (the dir then loads via the legacy structural check).
+        if FLAGS.ckpt_verify:
+            manifest["files"] = {
+                fname: {"sha256": _sha256_file(os.path.join(tmp, fname)),
+                        "bytes": os.path.getsize(os.path.join(tmp, fname))}
+                for fname in sorted(os.listdir(tmp))}
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
         if os.path.exists(final):
@@ -60,6 +92,7 @@ def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
         shutil.rmtree(tmp, ignore_errors=True)
         raise
     log.info("saved checkpoint %s", final)
+    sweep_retention(save_dir, keep)
     return final
 
 
@@ -95,8 +128,163 @@ def load_manifest(ckpt_dir: str) -> Dict:
         return json.load(f)
 
 
+def _verify_result(ckpt_dir: str) -> str:
+    """``"ok"`` | ``"corrupt"`` (definitive mismatch/torn state) |
+    ``"unreadable"`` (a transient read fault — EIO/ESTALE on a shared
+    filesystem — proved nothing about the data)."""
+    if not os.path.isdir(ckpt_dir):
+        return "corrupt"
+    try:
+        manifest = load_manifest(ckpt_dir)
+    except (FileNotFoundError, json.JSONDecodeError):
+        manifest = None
+    except OSError:
+        return "unreadable"
+    files = (manifest or {}).get("files")
+    if files:
+        for fname, info in files.items():
+            path = os.path.join(ckpt_dir, fname)
+            if not os.path.exists(path):
+                log.warning("checkpoint %s: %s missing", ckpt_dir, fname)
+                return "corrupt"
+            try:
+                if os.path.getsize(path) != info.get("bytes"):
+                    log.warning("checkpoint %s: %s size mismatch",
+                                ckpt_dir, fname)
+                    return "corrupt"
+                if _sha256_file(path) != info.get("sha256"):
+                    log.warning("checkpoint %s: %s digest mismatch",
+                                ckpt_dir, fname)
+                    return "corrupt"
+            except OSError as e:
+                log.warning("checkpoint %s: %s unreadable (%s)",
+                            ckpt_dir, fname, e)
+                return "unreadable"
+        return "ok"
+    # legacy / foreign dir: no digests recorded — check the archives open
+    if not os.path.exists(os.path.join(ckpt_dir, "params.npz")):
+        return "corrupt"
+    for fname in ("params.npz", "buffers.npz", "opt_state.npz"):
+        p = os.path.join(ckpt_dir, fname)
+        if not os.path.exists(p):
+            continue
+        try:
+            with np.load(p):
+                pass
+        except OSError:
+            return "unreadable"
+        except Exception:
+            log.warning("checkpoint %s: %s does not open", ckpt_dir, fname)
+            return "corrupt"
+    return "ok"
+
+
+def verify_checkpoint(ckpt_dir: str) -> bool:
+    """True iff ``ckpt_dir`` passes integrity checks.
+
+    Format-2 checkpoints (manifest with ``files``) re-hash every listed
+    file against its recorded SHA-256 + size.  Older dirs (legacy
+    manifest, or a bare params.npz from an external tool) degrade to a
+    structural check: the archives must exist and open as valid zips.
+    """
+    return _verify_result(ckpt_dir) == "ok"
+
+
+def _pass_dirs(save_dir: str) -> List[str]:
+    return sorted(d for d in os.listdir(save_dir) if d.startswith("pass-"))
+
+
 def latest_checkpoint(save_dir: str) -> Optional[str]:
     if not os.path.isdir(save_dir):
         return None
-    passes = sorted(d for d in os.listdir(save_dir) if d.startswith("pass-"))
+    passes = _pass_dirs(save_dir)
     return os.path.join(save_dir, passes[-1]) if passes else None
+
+
+def quarantine_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Rename a corrupt checkpoint dir to ``.corrupt-<name>[-N]`` so
+    backward scans never re-validate it; returns the new path."""
+    parent, name = os.path.split(os.path.normpath(ckpt_dir))
+    target = os.path.join(parent, f".corrupt-{name}")
+    n = 0
+    while os.path.exists(target):
+        n += 1
+        target = os.path.join(parent, f".corrupt-{name}-{n}")
+    try:
+        os.rename(ckpt_dir, target)
+    except OSError as e:
+        log.warning("could not quarantine %s (%s)", ckpt_dir, e)
+        return None
+    log.warning("quarantined corrupt checkpoint %s -> %s", ckpt_dir, target)
+    return target
+
+
+def latest_valid_checkpoint(save_dir: str,
+                            quarantine: bool = True) -> Optional[str]:
+    """Newest ``pass-*`` dir that passes :func:`verify_checkpoint`,
+    scanning backward past corrupt/torn dirs (renamed ``.corrupt-*``
+    when ``quarantine``)."""
+    if not os.path.isdir(save_dir):
+        return None
+    for name in reversed(_pass_dirs(save_dir)):
+        path = os.path.join(save_dir, name)
+        verdict = _verify_result(path)
+        if verdict == "ok":
+            return path
+        log.warning("checkpoint %s failed verification (%s); falling "
+                    "back", path, verdict)
+        # only DEFINITIVE corruption is quarantined — a transient read
+        # fault must not get a valid checkpoint renamed away (and later
+        # reaped by the retention sweep)
+        if quarantine and verdict == "corrupt":
+            quarantine_checkpoint(path)
+    return None
+
+
+# a .tmp-ckpt-* dir older than this is an orphan from a save that was
+# SIGKILLed mid-write (no in-process cleanup ran); no live save under
+# the election window ever takes this long
+_TMP_STALE_S = 3600.0
+
+
+def _stale_tmp_dirs(save_dir: str) -> List[str]:
+    out = []
+    now = time.time()
+    for name in os.listdir(save_dir):
+        if not name.startswith(".tmp-ckpt-"):
+            continue
+        try:
+            if now - os.path.getmtime(os.path.join(save_dir, name)) \
+                    > _TMP_STALE_S:
+                out.append(name)
+        except OSError:
+            pass
+    return out
+
+
+def sweep_retention(save_dir: str, keep: Optional[int] = None) -> List[str]:
+    """Delete the oldest ``pass-*`` dirs beyond the newest ``keep``
+    (default ``--ckpt_keep``; 0 or negative disables).  Returns the
+    removed paths."""
+    keep = FLAGS.ckpt_keep if keep is None else keep
+    if keep is None or keep <= 0 or not os.path.isdir(save_dir):
+        return []
+    removed = []
+    # quarantined dirs are capped by the same keep count — recurring
+    # corruption (a bad disk region) must not grow storage unboundedly —
+    # and orphaned temp dirs from preemption-killed saves are reaped
+    corrupt = sorted(d for d in os.listdir(save_dir)
+                     if d.startswith(".corrupt-"))
+    for name in _pass_dirs(save_dir)[:-keep] + corrupt[:-keep] \
+            + _stale_tmp_dirs(save_dir):
+        path = os.path.join(save_dir, name)
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            log.warning("retention sweep could not remove %s (%s)", path, e)
+            continue
+        removed.append(path)
+    if removed:
+        log.info("retention sweep (keep=%d): removed %s", keep,
+                 [os.path.basename(p) for p in removed])
+    return removed

@@ -28,15 +28,17 @@ from ..core.device import DATA_AXIS, data_sharding, get_mesh, replicated
 from ..core.sequence import SequenceBatch, value_of
 from ..layers.network import NeuralNetwork
 from ..optimizer import Optimizer, create_optimizer, make_schedule
-from ..utils import FLAGS, enforce, get_logger, global_stat
+from ..utils import FLAGS, PaddleTpuError, enforce, get_logger, global_stat
 from . import events as ev
 from .checkpoint import (
     latest_checkpoint,
+    latest_valid_checkpoint,
     load_buffers,
     load_manifest,
     load_opt_state,
     load_params,
     save_checkpoint,
+    verify_checkpoint,
 )
 
 log = get_logger("trainer")
@@ -452,7 +454,15 @@ class Trainer:
                                self.opt_state, self.buffers,
                                meta={"samples_seen": self.samples_seen})
 
-    def load(self, ckpt_dir: str) -> None:
+    def load(self, ckpt_dir: str, _verified: bool = False) -> None:
+        # _verified: resume() already digest-checked this dir via
+        # latest_valid_checkpoint — don't re-hash a multi-GB checkpoint
+        if FLAGS.ckpt_verify and not _verified \
+                and not verify_checkpoint(ckpt_dir):
+            raise PaddleTpuError(
+                f"checkpoint {ckpt_dir!r} failed integrity verification "
+                "(manifest digest mismatch or torn files); pass "
+                "--ckpt_verify=false to force the legacy blind load")
         loaded = load_params(ckpt_dir)
         missing = set(self.params) - set(loaded)
         if missing:
@@ -483,10 +493,16 @@ class Trainer:
             self._train_step = None  # re-capture the new masks
 
     def resume(self, save_dir: str) -> bool:
-        ckpt = latest_checkpoint(save_dir)
+        """Load the newest checkpoint that passes digest verification,
+        scanning backward past (and quarantining) corrupt dirs;
+        ``--ckpt_verify=false`` restores the legacy blind-latest load."""
+        if FLAGS.ckpt_verify:
+            ckpt = latest_valid_checkpoint(save_dir)
+        else:
+            ckpt = latest_checkpoint(save_dir)
         if ckpt is None:
             return False
-        self.load(ckpt)
+        self.load(ckpt, _verified=FLAGS.ckpt_verify)
         return True
 
 

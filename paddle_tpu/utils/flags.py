@@ -135,6 +135,21 @@ FLAGS.define("fused_rnn_hblock", True,
              "[H, gates*128] column blocks instead of falling back to "
              "lax.scan; off = the round-7 H<=512 gate, for one-flag "
              "revert / A/B measurement")
+FLAGS.define("master_retry_max", 5,
+             "reconnect attempts per master RPC: on connection loss the "
+             "TCP MasterClient re-dials with exponential backoff + jitter "
+             "and replays the request up to this many times; 0 restores "
+             "the legacy fail-fast behavior (first drop raises "
+             "PaddleTpuError)")
+FLAGS.define("ckpt_keep", 5,
+             "checkpoint retention: keep the newest N pass-* dirs after "
+             "each save and delete older ones; 0 disables the sweep "
+             "(keep everything, the legacy behavior)")
+FLAGS.define("ckpt_verify", True,
+             "verify per-file SHA-256 digests from the checkpoint "
+             "manifest on load, and make resume scan backward past "
+             "corrupt checkpoints (quarantined as .corrupt-*); off = "
+             "the legacy blind latest-checkpoint load")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

@@ -1,0 +1,530 @@
+"""Chaos suite: the fault-tolerance guarantees, *verified* by injection.
+
+Every recovery path the elastic-training story promises is driven by a
+fault from ``paddle_tpu.testing.fault`` and asserted end-to-end:
+training completes, every sample trains at least once, and resume lands
+on the newest checkpoint that passes digest verification.  All faults
+are deterministic (call-count triggers, fixed seeds); only loopback TCP
+and the local filesystem are touched.  The process-kill variants are
+additionally marked ``slow``.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import ElasticTrainer, Master, MasterClient, \
+    master_reader
+from paddle_tpu.testing import fault
+from paddle_tpu.trainer.checkpoint import (
+    latest_checkpoint,
+    latest_valid_checkpoint,
+    load_manifest,
+    sweep_retention,
+    verify_checkpoint,
+)
+from paddle_tpu.utils import FLAGS, PaddleTpuError
+
+from test_distributed import _CountingTrainer, _shard_samples, _tiny_trainer
+
+pytestmark = pytest.mark.chaos
+
+
+def _fast_client(port, retry_max=8):
+    return MasterClient(f"127.0.0.1:{port}", retry_max=retry_max,
+                        retry_base_s=0.01, retry_cap_s=0.2)
+
+
+def _load4(payload):
+    return [(payload, i) for i in range(4)]
+
+
+# ------------------------------------------------- reconnecting client
+def test_reconnect_survives_request_drops_mid_epoch():
+    """TCP drops before the request is sent: the client re-dials,
+    replays, and the epoch completes with every sample trained once."""
+    m = Master(timeout_s=30, failure_max=3)
+    port = m.serve(0)
+    c = _fast_client(port)
+    c.set_dataset([f"s{i}" for i in range(4)])
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, c, _load4, save_dir="/tmp/none",
+                        checkpoint_every_s=1e9)
+    with fault.drop_master_connection(c, every=3) as stats:
+        et.train(feeder=None, batch_size=4, num_epochs=2)
+    assert stats["dropped"] > 0
+    assert sum(tr.batches) == 2 * 16          # request-loss: exactly once
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0
+    c.close()
+
+
+def test_reconnect_survives_response_drops_at_least_once():
+    """TCP drops after the request reaches the master: a GET's lease is
+    granted but never heard, so it must time out server-side and
+    re-queue — at-least-once delivery, epoch still completes."""
+    m = Master(timeout_s=0.3, failure_max=10)  # fast lease-timeout rescue
+    port = m.serve(0)
+    c = _fast_client(port)
+    c.set_dataset([f"s{i}" for i in range(4)])
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, c, _load4, save_dir="/tmp/none",
+                        checkpoint_every_s=1e9)
+    with fault.drop_master_connection(c, every=4, limit=3,
+                                      when="response") as stats:
+        et.train(feeder=None, batch_size=4, num_epochs=1)
+    assert stats["dropped"] == 3
+    assert sum(tr.batches) >= 16              # at-least-once, never less
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0
+    c.close()
+
+
+def test_retry_max_zero_reproduces_fail_fast():
+    """--master_retry_max=0 restores today's behavior exactly: the first
+    dropped connection raises PaddleTpuError('master connection
+    closed')."""
+    m = Master(timeout_s=5, failure_max=3)
+    port = m.serve(0)
+    c = MasterClient(f"127.0.0.1:{port}", retry_max=0)
+    assert c.ping() is True
+    fault._kill_socket(c._sock)
+    with pytest.raises(PaddleTpuError, match="^master connection closed$"):
+        c.counts()
+    c.close()
+
+
+def test_retry_default_comes_from_flag():
+    m = Master(timeout_s=5, failure_max=3)
+    port = m.serve(0)
+    old = FLAGS.master_retry_max
+    FLAGS.set("master_retry_max", 0)
+    try:
+        c = MasterClient(f"127.0.0.1:{port}")
+        assert c._retry_max == 0
+        fault._kill_socket(c._sock)
+        with pytest.raises(PaddleTpuError):
+            c.counts()
+        c.close()
+    finally:
+        FLAGS.set("master_retry_max", old)
+
+
+def test_ping_answers_fast_when_master_is_down():
+    """ping() is a probe, not an RPC: it gets at most one re-dial, so a
+    dead master yields False promptly instead of blocking through the
+    full reconnect budget."""
+    m = Master(timeout_s=5, failure_max=3)
+    port = m.serve(0)
+    c = MasterClient(f"127.0.0.1:{port}", retry_max=10,
+                     retry_base_s=0.01, retry_cap_s=0.05)
+    assert c.ping() is True
+    del m                                      # server torn down
+    t0 = time.monotonic()
+    assert c.ping() is False                   # no 10-attempt stall
+    assert time.monotonic() - t0 < 2.0
+    c.close()
+
+
+def test_sweep_reaps_stale_tmp_dirs(tmp_path):
+    """A save SIGKILLed mid-write leaves a .tmp-ckpt-* orphan (no
+    in-process cleanup ran); the retention sweep reaps it once stale,
+    but never touches a fresh one (a live concurrent save)."""
+    from paddle_tpu.trainer import checkpoint as ck
+
+    save_dir = str(tmp_path / "ckpt")
+    os.makedirs(save_dir)
+    stale = os.path.join(save_dir, ".tmp-ckpt-dead")
+    fresh = os.path.join(save_dir, ".tmp-ckpt-live")
+    os.makedirs(stale)
+    os.makedirs(fresh)
+    old = time.time() - ck._TMP_STALE_S - 60
+    os.utime(stale, (old, old))
+    removed = sweep_retention(save_dir, keep=1)
+    assert removed == [stale]
+    assert os.path.isdir(fresh) and not os.path.isdir(stale)
+
+
+def test_client_context_manager_and_idempotent_close():
+    m = Master(timeout_s=5, failure_max=3)
+    port = m.serve(0)
+    with MasterClient(f"127.0.0.1:{port}") as c:
+        assert c.ping() is True
+    c.close()                                  # second close: no-op
+    c.close()
+    with pytest.raises(PaddleTpuError, match="closed"):
+        c.counts()
+
+
+def test_master_reader_closes_client_on_abandonment():
+    # short lease timeout: the first generator abandons a lease mid-read
+    # and the drain below must not wait long for its re-queue
+    m = Master(timeout_s=0.3, failure_max=3)
+    port = m.serve(0)
+    c = MasterClient(f"127.0.0.1:{port}")
+    c.set_dataset([f"s{i}" for i in range(3)])
+    gen = master_reader(c, _load4)()
+    next(gen)
+    gen.close()                                # abandoned mid-pass
+    assert c._closed is True                   # no leaked master socket
+    # the abandoned lease was FAILed (immediate re-queue), not left to
+    # burn its full server-side timeout
+    cnt = m.counts()
+    assert cnt["pending"] == 0 and cnt["todo"] == 3
+    # normal exhaustion leaves the client OPEN: the reader is
+    # re-invocable (one call per pass, Trainer.train-style)
+    c2 = MasterClient(f"127.0.0.1:{port}")
+    reader = master_reader(c2, _load4)
+    list(reader())
+    assert c2._closed is False
+    c2.reset_epoch(c2.current_epoch() + 1)     # next pass still works
+    assert len(list(reader())) == 12
+    # opt-out for shared clients: abandonment must NOT close
+    gen3 = master_reader(c2, _load4, close_client=False)()
+    c2.reset_epoch(c2.current_epoch() + 1)
+    next(gen3)
+    gen3.close()
+    assert c2._closed is False
+    c2.close()
+
+
+# --------------------------------------------- master process kill/restart
+@pytest.mark.slow
+def test_master_kill_restart_client_reconnects(tmp_path):
+    """SIGKILL the serving master mid-lease; the client backs off through
+    ECONNREFUSED until the restarted process (same port, recovered from
+    snapshot) answers, and training state survived."""
+    snap = str(tmp_path / "snap")
+    srv = fault.MasterServerProcess(snap, timeout_s=5, failure_max=3)
+    srv.start()
+    try:
+        c = MasterClient(srv.addr, retry_max=10, retry_base_s=0.05,
+                         retry_cap_s=0.5)
+        c.set_dataset(["a", "b", "c"])
+        tid, _ = c.get_task()
+        c.task_finished(tid)                   # snapshot: done=1, todo=2
+        c.get_task()                           # lease b — never finished
+        srv.kill()
+        t = threading.Timer(0.4, srv.start)
+        t.start()
+        try:
+            cnt = c.counts()                   # blocks through backoff
+        finally:
+            t.join()
+        # the in-process pins of test_master_snapshot_recover: progress
+        # survived, the unheard lease re-queued as todo
+        assert cnt["done"] == 1 and cnt["todo"] == 2 and cnt["pending"] == 0
+        got = []
+        while True:
+            tid, p = c.get_task()
+            if p is None:
+                break
+            got.append(p)
+            c.task_finished(tid)
+        assert sorted(got) == ["b", "c"]       # 'a' stayed done
+        c.close()
+    finally:
+        srv.kill()
+
+
+@pytest.mark.slow
+def test_elastic_completes_through_master_kill(tmp_path):
+    """Full elastic run with the master process SIGKILLed mid-epoch and
+    restarted from its snapshot: all epochs complete, every sample
+    trains at least once."""
+    snap = str(tmp_path / "snap")
+    srv = fault.MasterServerProcess(snap, timeout_s=2, failure_max=5)
+    srv.start()
+    try:
+        c = MasterClient(srv.addr, retry_max=12, retry_base_s=0.05,
+                         retry_cap_s=0.5)
+        c.set_dataset([f"s{i}" for i in range(6)])
+        tr = _CountingTrainer()
+        et = ElasticTrainer(tr, c, _load4, save_dir=str(tmp_path / "ck"),
+                            checkpoint_every_s=1e9)
+        calls = {"n": 0}
+        orig = c._call
+
+        def killing_call(line):
+            calls["n"] += 1
+            if calls["n"] == 7:                # mid-epoch, deterministic
+                srv.kill()
+                threading.Timer(0.3, srv.start).start()
+            return orig(line)
+
+        c._call = killing_call
+        try:
+            et.train(feeder=None, batch_size=4, num_epochs=2)
+        finally:
+            c._call = orig
+        assert sum(tr.batches) >= 2 * 24       # at-least-once, both epochs
+        cnt = c.counts()
+        assert cnt["pending"] == 0 and cnt["failed"] == 0
+        c.close()
+    finally:
+        srv.kill()
+
+
+# ----------------------------------------------------- poisoned shards
+def test_poisoned_shard_does_not_kill_training():
+    """One shard raises inside load_fn twice; the lease FAILs, the
+    master re-queues it, and the epoch completes with every sample
+    trained at least once."""
+    m = Master(timeout_s=1e6, failure_max=5)
+    m.set_dataset([f"s{i}" for i in range(4)])
+    poisoned = fault.poison_load_fn(_load4, ["s2"], times=2)
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, m, poisoned, save_dir="/tmp/none",
+                        checkpoint_every_s=1e9)
+    et.train(feeder=None, batch_size=4, num_epochs=1)
+    assert poisoned.hits == {"s2": 2}
+    assert sum(tr.batches) >= 16
+    cnt = m.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0 and cnt["todo"] == 4
+
+
+def test_permanently_poisoned_shard_hits_failure_cap():
+    """A shard that never loads ends in `failed` after failure_max
+    attempts; the rest of the epoch still completes."""
+    m = Master(timeout_s=1e6, failure_max=2)
+    m.set_dataset(["good", "bad"])
+    poisoned = fault.poison_load_fn(_load4, ["bad"], times=-1)
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, m, poisoned, save_dir="/tmp/none",
+                        checkpoint_every_s=1e9)
+    et.train(feeder=None, batch_size=4, num_epochs=1)
+    assert sum(tr.batches) == 4                # the good shard trained
+    # the epoch-end reset already re-queued the failed shard for the
+    # next pass (failures reset); nothing is stuck pending
+    cnt = m.counts()
+    assert cnt["todo"] == 2 and cnt["pending"] == 0
+
+
+# ----------------------------------------- checkpoint integrity faults
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_resume_falls_back_past_corrupt_checkpoint(tmp_path, mode):
+    """Corrupting the newest checkpoint (torn write or silent bit-flip)
+    makes resume land on the previous valid one and quarantines the bad
+    dir as .corrupt-*."""
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    batch = feeder.convert(list(_shard_samples("s0")))
+    tr.train_one_batch(batch)
+    tr.save(save_dir, 0)
+    tr.train_one_batch(batch)
+    tr.save(save_dir, 1)
+    fault.corrupt_checkpoint(os.path.join(save_dir, "pass-00001"),
+                             mode=mode)
+    assert verify_checkpoint(os.path.join(save_dir, "pass-00001")) is False
+
+    tr2, _ = _tiny_trainer(seed=99)
+    assert tr2.resume(save_dir) is True
+    # landed on pass-00000, the newest checkpoint that verifies
+    assert tr2.samples_seen == load_manifest(
+        os.path.join(save_dir, "pass-00000"))["samples_seen"]
+    dirs = sorted(os.listdir(save_dir))
+    assert ".corrupt-pass-00001" in dirs and "pass-00001" not in dirs
+
+
+def test_transient_read_fault_does_not_quarantine(tmp_path, monkeypatch):
+    """A transient OSError during verification (EIO/ESTALE on a shared
+    fs) proves nothing about the data: the scan skips the dir WITHOUT
+    quarantining it, so a valid checkpoint is never renamed away and
+    later reaped over a read blip."""
+    from paddle_tpu.trainer import checkpoint as ck
+
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    tr.train_one_batch(feeder.convert(list(_shard_samples("s0"))))
+    tr.save(save_dir, 0)
+
+    def flaky_sha(path):
+        raise OSError(5, "injected EIO")
+
+    monkeypatch.setattr(ck, "_sha256_file", flaky_sha)
+    assert latest_valid_checkpoint(save_dir) is None   # nothing proved ok
+    assert sorted(os.listdir(save_dir)) == ["pass-00000"]  # not renamed
+    monkeypatch.undo()
+    assert latest_valid_checkpoint(save_dir).endswith("pass-00000")
+
+
+def test_resume_all_corrupt_returns_false(tmp_path):
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    tr.train_one_batch(feeder.convert(list(_shard_samples("s0"))))
+    tr.save(save_dir, 0)
+    fault.corrupt_checkpoint(os.path.join(save_dir, "pass-00000"))
+    tr2, _ = _tiny_trainer(seed=99)
+    assert tr2.resume(save_dir) is False
+    assert latest_valid_checkpoint(save_dir) is None
+
+
+def test_ckpt_verify_kill_switch_restores_blind_crash(tmp_path):
+    """--ckpt_verify=false reproduces the legacy failure mode exactly:
+    resume blindly loads the newest dir, and the corrupt .npz crashes
+    the load (no fallback, no quarantine)."""
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    tr.train_one_batch(feeder.convert(list(_shard_samples("s0"))))
+    tr.save(save_dir, 0)
+    tr.save(save_dir, 1)
+    fault.corrupt_checkpoint(os.path.join(save_dir, "pass-00001"),
+                             mode="bitflip")
+    old = FLAGS.ckpt_verify
+    FLAGS.set("ckpt_verify", False)
+    try:
+        tr2, _ = _tiny_trainer(seed=99)
+        with pytest.raises(Exception):         # zip CRC / parse error
+            tr2.resume(save_dir)
+        # the corrupt dir is still there — nothing was quarantined
+        assert latest_checkpoint(save_dir).endswith("pass-00001")
+    finally:
+        FLAGS.set("ckpt_verify", old)
+
+
+def test_checkpoint_retention_sweep(tmp_path):
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    tr.train_one_batch(feeder.convert(list(_shard_samples("s0"))))
+    old = FLAGS.ckpt_keep
+    FLAGS.set("ckpt_keep", 3)
+    try:
+        for p in range(6):
+            tr.save(save_dir, p)
+        dirs = sorted(d for d in os.listdir(save_dir)
+                      if d.startswith("pass-"))
+        assert dirs == ["pass-00003", "pass-00004", "pass-00005"]
+        # keep=0 disables the sweep
+        FLAGS.set("ckpt_keep", 0)
+        assert sweep_retention(save_dir) == []
+    finally:
+        FLAGS.set("ckpt_keep", old)
+
+
+def test_retention_never_sweeps_the_only_valid_checkpoint(tmp_path):
+    """Quarantined .corrupt-* dirs don't count against keep-last-N and
+    are never swept (they are renamed out of the pass-* namespace)."""
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    tr.train_one_batch(feeder.convert(list(_shard_samples("s0"))))
+    tr.save(save_dir, 0)
+    tr.save(save_dir, 1)
+    fault.corrupt_checkpoint(os.path.join(save_dir, "pass-00001"))
+    assert latest_valid_checkpoint(save_dir).endswith("pass-00000")
+    sweep_retention(save_dir, keep=1)
+    left = sorted(os.listdir(save_dir))
+    assert "pass-00000" in left and ".corrupt-pass-00001" in left
+    # ...but recurring corruption is still bounded: quarantined dirs
+    # beyond keep are reaped too (a bad disk region must not grow
+    # storage without limit)
+    for p in (2, 3, 4):
+        tr.save(save_dir, p)
+        fault.corrupt_checkpoint(
+            os.path.join(save_dir, f"pass-{p:05d}"))
+        assert latest_valid_checkpoint(save_dir)  # quarantines pass-p
+    sweep_retention(save_dir, keep=1)
+    corrupt_left = [d for d in os.listdir(save_dir)
+                    if d.startswith(".corrupt-")]
+    assert len(corrupt_left) == 1
+
+
+# ------------------------------------------------------ disk-full saves
+def test_disk_full_save_skips_window_and_recovers(tmp_path):
+    """A failing periodic save logs + skips its window; once the disk
+    'recovers', the next save succeeds and training completed anyway."""
+    m = Master(timeout_s=1e6, failure_max=3)
+    m.set_dataset([f"s{i}" for i in range(3)])
+    tr, feeder = _tiny_trainer()
+    save_dir = str(tmp_path / "ckpt")
+    et = ElasticTrainer(tr, m, _shard_samples, save_dir,
+                        checkpoint_every_s=0.0)  # attempt every batch
+    with fault.failing_saves(tr, times=2) as stats:
+        et.train(feeder, batch_size=8, num_epochs=1)
+    assert stats["failed"] == 2 and stats["succeeded"] >= 1
+    assert m.counts()["pending"] == 0
+    # the surviving checkpoint is valid and loadable
+    ckpt = latest_valid_checkpoint(save_dir)
+    assert ckpt is not None and verify_checkpoint(ckpt)
+
+
+def test_disk_full_escalates_only_at_epoch_end_after_n_failures(tmp_path):
+    """With the disk permanently full, periodic saves are skipped
+    (training continues) and only the epoch-end force save raises, after
+    ckpt_fail_max consecutive failures."""
+    m = Master(timeout_s=1e6, failure_max=3)
+    m.set_dataset([f"s{i}" for i in range(3)])
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, m, _load4, save_dir=str(tmp_path / "ck"),
+                        checkpoint_every_s=0.0, ckpt_fail_max=3)
+    with fault.failing_saves(tr, times=-1) as stats:
+        with pytest.raises(OSError):
+            et.train(feeder=None, batch_size=4, num_epochs=1)
+    # every sample still trained before the epoch-end escalation
+    assert sum(tr.batches) == 12
+    assert stats["failed"] >= 3
+
+
+def test_failed_save_releases_election_to_healthy_peer():
+    """The trainer whose save failed gives the election window back
+    (interval < 0 releases), so a healthy peer can checkpoint it instead
+    of the fleet silently losing the window."""
+    m = Master(timeout_s=5, failure_max=3)
+    assert m.request_save_model("sick", 30.0) is True
+    assert m.request_save_model("healthy", 30.0) is False  # sick owns it
+    m.request_save_model("sick", -1.0)         # sick's save failed
+    assert m.request_save_model("healthy", 30.0) is True
+    # a non-owner's stray release must not steal the window
+    m.request_save_model("other", -1.0)
+    assert m.request_save_model("sick", 30.0) is False
+
+
+def test_one_failed_force_save_does_not_escalate(tmp_path):
+    """A single epoch-end save failure (no prior failures) is logged and
+    skipped — escalation needs ckpt_fail_max consecutive failures."""
+    m = Master(timeout_s=1e6, failure_max=3)
+    m.set_dataset(["s0"])
+    tr = _CountingTrainer()
+    et = ElasticTrainer(tr, m, _load4, save_dir=str(tmp_path / "ck"),
+                        checkpoint_every_s=1e9, ckpt_fail_max=3)
+    with fault.failing_saves(tr, times=1):
+        et.train(feeder=None, batch_size=4, num_epochs=1)  # no raise
+    assert sum(tr.batches) == 4
+
+
+# ------------------------------------------ the whole gauntlet at once
+def test_gauntlet_all_faults_one_run(tmp_path):
+    """Everything together on loopback TCP: connection drops, one
+    transiently poisoned shard, two disk-full saves — the run completes
+    all epochs, trains every sample at least once, and leaves a valid
+    checkpoint that a fresh trainer resumes from (past an
+    injected-corrupt newer one)."""
+    m = Master(timeout_s=0.5, failure_max=5)
+    port = m.serve(0)
+    c = _fast_client(port, retry_max=10)
+    c.set_dataset([f"s{i}" for i in range(5)])
+    save_dir = str(tmp_path / "ckpt")
+    tr, feeder = _tiny_trainer()
+    poisoned = fault.poison_load_fn(_shard_samples, ["s3"], times=1)
+    et = ElasticTrainer(tr, c, poisoned, save_dir,
+                        checkpoint_every_s=0.0)
+    with fault.drop_master_connection(c, every=5, limit=4) as drops, \
+            fault.failing_saves(tr, times=2) as saves:
+        et.train(feeder, batch_size=8, num_epochs=2)
+    assert drops["dropped"] > 0 and saves["failed"] == 2
+    assert poisoned.hits == {"s3": 1}
+    assert tr.samples_seen >= 2 * 5 * 8        # at-least-once, 2 epochs
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0
+    c.close()
+
+    # newest checkpoint corrupted post-hoc: resume must fall back
+    newest = latest_checkpoint(save_dir)
+    fault.corrupt_checkpoint(newest, mode="bitflip")
+    tr2, _ = _tiny_trainer(seed=7)
+    et2 = ElasticTrainer(tr2, m, _shard_samples, save_dir)
+    assert et2.resume() is True
+    assert tr2.samples_seen > 0
+    assert os.path.basename(latest_valid_checkpoint(save_dir)) \
+        != os.path.basename(newest)

@@ -93,6 +93,98 @@ def test_download_md5_cache(tmp_path, monkeypatch):
         download("http://example.invalid/other.bin", "m", "0" * 32)
 
 
+def test_download_retries_transient_errors(tmp_path, monkeypatch):
+    """A transient OSError consumes one retry (exponential backoff with
+    jitter) instead of raising immediately; DownloadError fires only
+    once retry_limit is exhausted.  The .part temp file is cleaned up
+    after every failed attempt."""
+    import urllib.request
+
+    import paddle_tpu.data.download as dl
+    monkeypatch.setattr(dl, "DATA_HOME", str(tmp_path))
+    monkeypatch.delenv("PADDLE_TPU_NO_DOWNLOAD", raising=False)
+    sleeps = []
+    monkeypatch.setattr(dl.time, "sleep", sleeps.append)
+    payload = b"corpus bytes"
+    attempts = {"n": 0}
+
+    class _Resp:
+        def __init__(self):
+            self._data = payload
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+        def read(self, n=-1):
+            data, self._data = self._data, b""
+            return data
+
+    def urlopen(url, timeout=0):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise OSError("connection reset by peer")
+        return _Resp()
+
+    monkeypatch.setattr(urllib.request, "urlopen", urlopen)
+    got = download("http://example.invalid/corpus.bin", "m",
+                   md5file_bytes(payload), retry_limit=3,
+                   backoff_base_s=0.01)
+    assert attempts["n"] == 3                  # 2 failures + 1 success
+    assert len(sleeps) == 2 and sleeps[1] > 0  # backed off between tries
+    assert open(got, "rb").read() == payload
+    assert not os.path.exists(got + ".part")
+
+    # exhaustion: every attempt fails → DownloadError names the last error
+    attempts["n"] = -100
+    monkeypatch.setattr(
+        urllib.request, "urlopen",
+        lambda url, timeout=0: (_ for _ in ()).throw(
+            OSError("no route to host")))
+    with pytest.raises(DownloadError, match="no route to host"):
+        download("http://example.invalid/gone.bin", "m", "0" * 32,
+                 retry_limit=3, backoff_base_s=0.01)
+    assert not os.path.exists(tmp_path / "m" / "gone.bin.part")
+
+    # a permanent HTTP 4xx fails fast — no retries burned
+    import urllib.error
+    calls = {"n": 0}
+
+    def urlopen_404(url, timeout=0):
+        calls["n"] += 1
+        raise urllib.error.HTTPError(url, 404, "Not Found", None, None)
+
+    monkeypatch.setattr(urllib.request, "urlopen", urlopen_404)
+    with pytest.raises(DownloadError, match="HTTP 404"):
+        download("http://example.invalid/missing.bin", "m", "0" * 32,
+                 retry_limit=3, backoff_base_s=0.01)
+    assert calls["n"] == 1
+
+    # 429 (rate limited) is transient despite being 4xx: retried
+    calls["n"] = 0
+
+    def urlopen_429_then_ok(url, timeout=0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise urllib.error.HTTPError(url, 429, "Too Many Requests",
+                                         None, None)
+        return _Resp()
+
+    monkeypatch.setattr(urllib.request, "urlopen", urlopen_429_then_ok)
+    got = download("http://example.invalid/limited.bin", "m",
+                   md5file_bytes(payload), retry_limit=3,
+                   backoff_base_s=0.01)
+    assert calls["n"] == 2 and open(got, "rb").read() == payload
+
+
+def md5file_bytes(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.md5(data).hexdigest()
+
+
 def test_loaders_fall_back_synthetic(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_NO_DOWNLOAD", "1")
     monkeypatch.setattr(datasets, "_download_failed", set())

@@ -168,6 +168,7 @@ def test_master_tcp_roundtrip():
     m = Master(timeout_s=5, failure_max=3)
     port = m.serve(0)
     c = MasterClient(f"127.0.0.1:{port}")
+    assert c.ping() is True          # liveness probe (PING op)
     c.set_dataset(["x", "y"])
     tid, payload = c.get_task()
     assert payload in ("x", "y")
@@ -176,6 +177,47 @@ def test_master_tcp_roundtrip():
     assert c.request_save_model("t0", 30.0) is True
     assert c.request_save_model("t1", 30.0) is False  # t0 holds the lease
     c.close()
+
+
+@pytest.mark.slow
+def test_master_live_serve_snapshot_recovery(tmp_path):
+    """The TCP-serving master, SIGKILLed mid-pass and restarted from its
+    snapshot, recovers the same state that the in-process pins of
+    test_master_snapshot_recover assert: done survives, the unheard
+    lease re-queues, the epoch counter persists."""
+    from paddle_tpu.testing.fault import MasterServerProcess
+
+    snap = str(tmp_path / "snap")
+    srv = MasterServerProcess(snap, timeout_s=5, failure_max=3)
+    srv.start()
+    try:
+        c = MasterClient(srv.addr, retry_max=10, retry_base_s=0.05,
+                         retry_cap_s=0.5)
+        c.set_dataset(["a", "b", "c"])
+        tid, _ = c.get_task()
+        c.task_finished(tid)         # snapshotted: done=1, todo=2
+        c.get_task()                 # live lease at kill time
+        srv.kill()
+        srv.start()                  # same port, recovered from snapshot
+        assert c.ping() is True      # the client re-dials transparently
+        cc = c.counts()
+        assert cc["todo"] == 2 and cc["done"] == 1   # the in-process pins
+        assert cc["pending"] == 0    # pending lease re-queued as todo
+        assert c.current_epoch() == 0
+        # drain + epoch handshake still work against the recovered master
+        got = []
+        while True:
+            tid, payload = c.get_task()
+            if payload is None:
+                break
+            got.append(payload)
+            c.task_finished(tid)
+        assert sorted(got) == ["b", "c"]
+        c.reset_epoch(1)
+        assert c.current_epoch() == 1
+        c.close()
+    finally:
+        srv.kill()
 
 
 def test_master_payload_escaping_tcp_and_snapshot(tmp_path):
