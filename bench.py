@@ -59,6 +59,7 @@ from functools import partial as _partial
 import jax
 import numpy as np
 
+from paddle_tpu import observe
 from paddle_tpu.utils import FLAGS
 
 PEAK_FLOPS_BF16 = 197e12      # v5e chip peak, bf16
@@ -522,6 +523,20 @@ def bench_attention():
     }), "attention", trainer, feed)
 
 
+def _workload_metrics(before):
+    """Per-workload telemetry merged onto the emitted JSON line: counter
+    DELTAS across the workload (dispatch-tier decisions, recompiles,
+    reconnects — which code path produced this number, not just the
+    timing) plus current gauges (fused-pair census, input-bound ratio,
+    fenced samples/sec when a sink is attached)."""
+    now = observe.REGISTRY.flat(kinds=("counter",))
+    out = {k: round(v - before.get(k, 0.0), 6)
+           for k, v in now.items() if v != before.get(k, 0.0)}
+    out.update({k: round(v, 6)
+                for k, v in observe.REGISTRY.flat(kinds=("gauge",)).items()})
+    return out
+
+
 def main():
     # persistent compile cache: cuts a resnet attempt from ~3.5 to ~2
     # minutes (the driver's run inherits warm compiles from the build's
@@ -545,9 +560,14 @@ def main():
     ap.add_argument("--profile_dir", default="./profiles",
                     help="root directory for --profile trace dumps")
     # framework flags ride the same CLI (e.g. --fused_rnn_hblock=false
-    # for an A/B of the blocked RNN tier against the scan path)
+    # for an A/B of the blocked RNN tier against the scan path, or
+    # --metrics_jsonl/--log_level for the telemetry satellites)
     import sys
     args = ap.parse_args(FLAGS.parse_argv(sys.argv[1:]))
+    if FLAGS.get("log_level"):
+        from paddle_tpu.utils import set_log_level
+        set_log_level(FLAGS.get("log_level"))
+    observe.start_from_flags()
     if args.profile:
         global PROFILE_DIR
         PROFILE_DIR = args.profile_dir
@@ -559,7 +579,10 @@ def main():
                                            "lstm2048"]
     for name in order:
         try:
-            print(json.dumps(benches[name]()), flush=True)
+            before = observe.REGISTRY.flat(kinds=("counter",))
+            r = benches[name]()
+            r["metrics"] = _workload_metrics(before)
+            print(json.dumps(r), flush=True)
         except Exception as e:          # noqa: BLE001 — report, don't die
             if name == order[0]:
                 raise                   # the parsed line must be honest

@@ -228,6 +228,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="e.g. data=4,model=2 (replaces --trainer_count)")
     tp.add_argument("--use_bf16", type=int, default=None)
     tp.add_argument("--bf16_activations", type=int, default=None)
+    tp.add_argument("--log_level", default="",
+                    help="framework log level "
+                         "(debug|info|warning|error|fatal)")
+    tp.add_argument("--metrics_jsonl", default="",
+                    help="telemetry sink: append one metrics+timers "
+                         "snapshot line here every "
+                         "--metrics_interval_s seconds")
+    tp.add_argument("--metrics_interval_s", type=float, default=None)
     tp.set_defaults(fn=cmd_train)
 
     mp = sub.add_parser(
@@ -286,6 +294,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         FLAGS.set("use_bf16", bool(args.use_bf16))
     if getattr(args, "bf16_activations", None) is not None:
         FLAGS.set("bf16_activations", bool(args.bf16_activations))
+    if getattr(args, "log_level", "") or FLAGS.get("log_level"):
+        from .utils import set_log_level
+        if getattr(args, "log_level", ""):
+            FLAGS.set("log_level", args.log_level)
+        set_log_level(FLAGS.get("log_level"))
+    if getattr(args, "metrics_jsonl", ""):
+        FLAGS.set("metrics_jsonl", args.metrics_jsonl)
+    if getattr(args, "metrics_interval_s", None) is not None:
+        FLAGS.set("metrics_interval_s", args.metrics_interval_s)
+    if FLAGS.get("metrics_jsonl"):
+        from . import observe
+        observe.start_from_flags()
     return args.fn(args)
 
 

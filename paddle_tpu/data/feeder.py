@@ -104,6 +104,15 @@ class DataFeeder:
     def convert(self, batch: List[Sequence]) -> Dict[str, Any]:
         """minibatch (list of sample tuples OR dicts keyed by data-layer
         name — both PyDataProvider2 sample conventions) → feed dict."""
+        from ..observe import histogram
+
+        with histogram(
+                "data_feed_convert_seconds",
+                "host time densifying/padding a minibatch into device "
+                "arrays (DataFeeder.convert)").time():
+            return self._convert(batch)
+
+    def _convert(self, batch: List[Sequence]) -> Dict[str, Any]:
         feed: Dict[str, Any] = {}
         for slot, (name, itype) in enumerate(self.feeding):
             col = [self._materialize(sample[name]

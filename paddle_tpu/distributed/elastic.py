@@ -15,6 +15,7 @@ import os
 import time
 from typing import Callable, List, Optional
 
+from ..observe import counter
 from ..trainer.trainer import Trainer
 from ..utils import get_logger
 
@@ -66,11 +67,17 @@ class ElasticTrainer:
             # disk has been bad for ckpt_fail_max consecutive attempts —
             # at that point progress durability is genuinely gone.
             self._ckpt_failures += 1
+            counter("elastic_skipped_saves",
+                    "checkpoint windows skipped after a failed save "
+                    "(disk fault survived)").inc()
             try:
                 # release the won election (interval < 0) so a healthy
                 # peer can checkpoint this window instead of the fleet
                 # silently losing it to our broken disk
                 self.client.request_save_model(self.trainer_id, -1.0)
+                counter("elastic_election_releases",
+                        "save-model elections released to a peer after "
+                        "a local save failure").inc()
             except Exception:  # noqa: BLE001 — best-effort release
                 pass
             log.warning(

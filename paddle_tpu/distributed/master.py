@@ -19,6 +19,7 @@ import time
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
+from ..observe import counter
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger
 
 log = get_logger("master")
@@ -255,15 +256,28 @@ class MasterClient:
                             "master closed the connection")
                     self._buf += chunk
                 resp, self._buf = self._buf.split(b"\n", 1)
+                if attempt:   # request survived via reconnect + replay
+                    counter("master_replays",
+                            "master RPCs completed on a replay after "
+                            "reconnect").inc()
                 return resp.decode()
             except OSError as e:  # incl. ConnectionError, socket.timeout
                 self._drop_sock()
                 if attempt >= retry_max:
+                    counter("master_giveups",
+                            "master RPCs that exhausted the reconnect "
+                            "budget and raised").inc()
                     raise PaddleTpuError("master connection closed") from e
                 delay = min(self._retry_cap_s,
                             self._retry_base_s * (2 ** attempt))
                 delay *= 0.5 + self._rng.random()  # jitter: [0.5, 1.5)x
                 attempt += 1
+                counter("master_reconnects",
+                        "master connection losses answered with a "
+                        "re-dial (per retry attempt)").inc()
+                counter("master_backoff_seconds",
+                        "total backoff slept before master re-dials"
+                        ).inc(delay)
                 log.warning(
                     "master call %s failed (%s: %s); reconnect attempt "
                     "%d/%d in %.2fs", line.split("\t", 1)[0],

@@ -229,6 +229,23 @@ class NeuralNetwork:
             for bn in self._bn_conv_fuse.values():
                 self._conv_bn_fuse.pop(bn, None)
 
+        # fused-pair census: how many conv/BN pairs THIS topology
+        # resolved at build time, per direction and kernel family —
+        # ResNet-50 pins 16 Pallas-3×3 + 16 GEMM-1×1 forward pairs (the
+        # round-7 resolution; its bwd entries are all evicted into fwd
+        # chains).  The bench artifact reads these back through the
+        # JSONL sink; gauges reflect the most recently built network.
+        from ..observe import gauge
+        fwd3 = sum(1 for cv in self._bn_conv_fuse
+                   if lmap[cv].attrs.get("filter_size") == 3)
+        pairs = gauge("network_conv_bn_fused_pairs",
+                      "conv/BN pairs resolved by the build-time fusion "
+                      "peepholes of the last-built network")
+        pairs.set(len(self._conv_bn_fuse), direction="bwd", kernel="3x3")
+        pairs.set(fwd3, direction="fwd", kernel="3x3")
+        pairs.set(len(self._bn_conv_fuse) - fwd3,
+                  direction="fwd", kernel="1x1")
+
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:
             for spec in layer.param_specs():

@@ -1,0 +1,53 @@
+"""paddle_tpu.observe — the unified telemetry layer.
+
+Typed metrics (Counter / Gauge / Histogram) in a process-wide registry,
+exported together with the ``StatSet`` wall-timer table through one
+reporter: a JSONL sink (``--metrics_jsonl PATH``, one self-describing
+line per flush interval) and an on-demand Prometheus text dump.
+
+Instrumented surfaces (all against :data:`REGISTRY`):
+
+- trainer: step latency split host-feed vs device-blocked, samples/sec,
+  jit recompiles (``paddle_tpu/trainer/trainer.py``);
+- data path: reader wait + feed-convert time → input-bound ratio;
+- dispatch tiers: RNN fused_blocked/fused/scan with fallback reasons,
+  conv+BN fused/chain/unfused (``ops/recurrent_ops.py``,
+  ``ops/nn_ops.py``), build-time fused-pair census
+  (``layers/network.py``);
+- fault tolerance: master reconnect/backoff/replay, checkpoint
+  save/verify latency + quarantines, elastic skipped-save/election
+  releases (``distributed/``, ``trainer/checkpoint.py``);
+- serving: request count + inference latency (``serving/loader.py``).
+
+Overhead contract: with no sink attached every instrument is a dict
+lookup + lock + add; anything more expensive (step fencing) is gated on
+:func:`active`.
+"""
+
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    format_labels,
+    gauge,
+    histogram,
+)
+from .report import (  # noqa: F401
+    MetricsReporter,
+    active,
+    attach,
+    prometheus_dump,
+    start_from_flags,
+    stop_global,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "REGISTRY", "counter", "gauge", "histogram",
+    "format_labels", "MetricsReporter", "active", "attach",
+    "prometheus_dump", "start_from_flags", "stop_global",
+]

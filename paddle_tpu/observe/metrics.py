@@ -1,0 +1,315 @@
+"""Typed process-wide metrics registry: Counter / Gauge / Histogram.
+
+The reference engine's observability spine is the ``StatSet`` timer table
+(``paddle/utils/Stat.h:63-242``) — wall timers only.  This module adds
+the other half the subsystems built since need: monotonic event counts
+(dispatch tiers, reconnects, quarantines), point-in-time gauges
+(input-bound ratio, fused-pair census), and fixed-bucket latency
+histograms (step/save/infer time), all exportable through one path
+(:mod:`paddle_tpu.observe.report`) together with the timer table.
+
+Design constraints, in order:
+
+- **zero dependencies** — stdlib only, importable from the serving
+  loader and the conftest without dragging in jax;
+- **near-zero overhead when no sink is attached** — an increment is one
+  dict lookup + a lock + a float add (~1 µs); anything that would fence
+  the device or serialize the dispatch pipeline lives with the callers,
+  gated on :func:`paddle_tpu.observe.report.active`;
+- **thread-safe** — every metric guards its label table with its own
+  lock (reader threads, the flush thread, and trainer threads race).
+
+Labels are free-form keyword arguments; each distinct label set is an
+independent sample series, Prometheus-style::
+
+    counter("rnn_dispatch_total").inc(kind="lstm", path="fused")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def format_labels(key: LabelKey) -> str:
+    """``((k, v), ...)`` → ``{k="v",...}`` (empty string for no labels)."""
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "abstract"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "type": self.kind, "help": self.help,
+                "samples": self.samples()}
+
+    def samples(self) -> List[Dict[str, Any]]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing count; ``inc`` of a negative amount is a
+    programming error and raises."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        amount = float(amount)   # numpy scalars would poison json.dumps
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name!r}: negative increment {amount}")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        """Sum over every label series."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [{"labels": dict(k), "value": v} for k, v in items]
+
+
+class Gauge(_Metric):
+    """Point-in-time value; settable, incrementable, decrementable."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        amount = float(amount)   # numpy scalars would poison json.dumps
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [{"labels": dict(k), "value": v} for k, v in items]
+
+
+# latency buckets in seconds: 0.5 ms … 60 s, the span from a fused-kernel
+# train step to a multi-GB checkpoint save
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (Prometheus ``le`` convention: a bucket
+    counts observations ``<= upper_bound``; ``+Inf`` is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Sequence[float]] = None):
+        super().__init__(name, help)
+        bs = tuple(sorted(buckets if buckets is not None
+                          else DEFAULT_BUCKETS))
+        if not bs:
+            raise ValueError(f"histogram {self.name!r}: needs >= 1 bucket")
+        self.buckets = bs
+        # per label set: [per-bucket counts + overflow, sum, count]
+        self._series: Dict[LabelKey, List[Any]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        value = float(value)     # numpy scalars would poison json.dumps
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * (len(self.buckets) + 1),
+                                         0.0, 0]
+            counts, _, _ = s
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            s[1] += value
+            s[2] += 1
+
+    @contextlib.contextmanager
+    def time(self, **labels) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, **labels)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s[2] if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s[1] if s else 0.0
+
+    def cumulative_buckets(self, **labels) -> List[Tuple[float, int]]:
+        """``[(le, cumulative_count), ...]`` ending with ``(inf, count)``."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            counts = list(s[0]) if s else [0] * (len(self.buckets) + 1)
+        out, acc = [], 0
+        for ub, c in zip(self.buckets + (math.inf,), counts):
+            acc += c
+            out.append((ub, acc))
+        return out
+
+    def samples(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = [(k, list(s[0]), s[1], s[2])
+                     for k, s in sorted(self._series.items())]
+        out = []
+        for key, counts, total, n in items:
+            acc, buckets = 0, []
+            for ub, c in zip(self.buckets + (math.inf,), counts):
+                acc += c
+                buckets.append(["+Inf" if ub == math.inf else ub, acc])
+            out.append({"labels": dict(key), "count": n,
+                        "sum": total, "buckets": buckets})
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create home for every metric in the process.
+
+    Re-requesting a name returns the existing instance; re-requesting it
+    as a different type raises — a name means one thing process-wide.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested as {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Self-describing dump of every metric (the JSONL line body)."""
+        return [m.describe() for m in self.metrics()]
+
+    def flat(self, kinds: Sequence[str] = ("counter", "gauge")
+             ) -> Dict[str, float]:
+        """``{'name{k="v"}': value}`` for scalar metric kinds — the
+        compact form bench lines and delta assertions consume."""
+        out: Dict[str, float] = {}
+        for m in self.metrics():
+            if m.kind not in kinds:
+                continue
+            for s in m.samples():
+                out[m.name + format_labels(_label_key(s["labels"]))] = \
+                    s["value"]
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition-format dump of the registry."""
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for s in m.samples():
+                key = _label_key(s["labels"])
+                if m.kind == "histogram":
+                    for le, acc in zip([b[0] for b in s["buckets"]],
+                                       [b[1] for b in s["buckets"]]):
+                        lk = _label_key({**s["labels"], "le": le})
+                        lines.append(
+                            f"{m.name}_bucket{format_labels(lk)} {acc}")
+                    lines.append(f"{m.name}_sum{format_labels(key)} "
+                                 f"{s['sum']}")
+                    lines.append(f"{m.name}_count{format_labels(key)} "
+                                 f"{s['count']}")
+                else:
+                    lines.append(
+                        f"{m.name}{format_labels(key)} {s['value']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a live process never resets)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: The process-wide registry every subsystem instruments against.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)

@@ -24,9 +24,21 @@ import numpy as np
 from jax import lax
 
 from ..core.dtypes import current_policy
+from ..observe import counter
 from .registry import register_op
 
 IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _record_conv_dispatch(op: str, path: str, reason: str = "") -> None:
+    """One lowering decision of the fused conv/BN family (trace-time:
+    ticks once per compiled program per shape — see the RNN counter in
+    ops/recurrent_ops.py for the convention)."""
+    counter(
+        "conv_dispatch_total",
+        "conv+BN lowering decisions by tier (trace-time; reason set "
+        "when a fusable-looking call took the unfused composition)",
+    ).inc(op=op, path=path, reason=reason)
 
 
 def _pair(v: IntOr2) -> Tuple[int, int]:
@@ -499,17 +511,24 @@ def affine_act_conv2d(z, a, c, w, conv_bias=None, act: str = "relu",
     fusable_act = act in ("relu", "", "linear")
     if is_training and fusable_act and pallas_conv.fusable_fwd(
             zs, ws, stride, padding, dilation, groups, data_format):
+        _record_conv_dispatch("affine_act_conv2d", "pallas3x3")
         out = pallas_conv._affine_conv_core(
             z.astype(pol.compute_dtype), a.astype(jnp.float32),
             c.astype(jnp.float32), w.astype(pol.compute_dtype), relu)
         out = out.astype(pol.output_dtype)
     elif is_training and fusable_act and _gemm_prologue_ok(
             zs, ws, stride, padding, dilation, groups, data_format):
+        _record_conv_dispatch("affine_act_conv2d", "gemm1x1")
         out = _affine_conv1x1_core(
             z.astype(pol.compute_dtype), a.astype(jnp.float32),
             c.astype(jnp.float32), w.astype(pol.compute_dtype), relu)
         out = out.astype(pol.output_dtype)
     else:
+        _record_conv_dispatch(
+            "affine_act_conv2d", "unfused",
+            "eval mode" if not is_training
+            else "non-fusable activation" if not fusable_act
+            else "off-tile shape/stride/layout")
         out = conv2d(_affine_apply(z, a, c, act), w, stride=stride,
                      padding=padding, dilation=dilation, groups=groups,
                      data_format=data_format)
@@ -580,6 +599,7 @@ def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
                                         dilation, groups, data_format)
                 and pallas_conv.fused_chain_ok(
                     xs[1], xs[2], int(ws[2]), int(ws[3]))):
+            _record_conv_dispatch("conv2d_bn", "chain")
             xc = x.astype(pol.compute_dtype)
             wc = w.astype(pol.compute_dtype)
             cb = jnp.zeros((wc.shape[3],), jnp.float32) \
@@ -596,6 +616,10 @@ def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
     if not (is_training and pallas_conv.fusable(
             jnp.shape(x), jnp.shape(w), stride, padding, dilation,
             groups, data_format)):
+        _record_conv_dispatch(
+            "conv2d_bn", "unfused",
+            "eval mode" if not is_training
+            else "off-tile shape/stride/layout")
         z = conv2d(x, w, stride=stride, padding=padding,
                    dilation=dilation, groups=groups,
                    data_format=data_format)
@@ -605,6 +629,7 @@ def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
                           momentum=momentum, eps=eps,
                           is_training=is_training,
                           data_format=data_format)
+    _record_conv_dispatch("conv2d_bn", "fused")
     xc = x.astype(pol.compute_dtype)
     wc = w.astype(pol.compute_dtype)
     cb = jnp.zeros((wc.shape[3],), jnp.float32) if conv_bias is None \

@@ -35,43 +35,61 @@ from jax import lax
 
 from ..core.dtypes import current_policy
 from ..core.sequence import SequenceBatch
-from ..utils.logger import get_logger
+from ..observe import counter
+from ..utils.logger import get_logger, warn_once
 from .activations import get_activation
 from .math_ops import matmul
 from .registry import register_op
 
 _log = get_logger("ops.recurrent")
-_fallback_warned: set = set()
 
 
-def _warn_scan_fallback(kind: str, b: int, h: int) -> None:
+def _fallback_reason(b: int, h: int) -> str:
+    """Why a default-activation (B, H) shape is off the fused tiers —
+    the structured label shared by the one-time warning and the
+    ``rnn_dispatch_total`` counter."""
+    from ..utils import FLAGS
+    if b % 8:
+        return "batch not a multiple of 8 (sublane tiling)"
+    if h % 128:
+        return "hidden not a multiple of 128 (lane tiling)"
+    if h > 512 and not FLAGS.fused_rnn_hblock:
+        return ("hidden>512 with the blocked tier disabled "
+                "(--fused_rnn_hblock=false)")
+    return ("hidden>512 and past even the blocked tier's "
+            "streamed-VMEM budget")
+
+
+def _record_dispatch(kind: str, b: int, h: int, path: str,
+                     reason: str = "") -> None:
+    """Count one lowering decision.  These ops run at TRACE time, so the
+    counter ticks once per compiled program per shape, not once per
+    executed step — exactly the "which path did this step take"
+    question (one series per (kind, path, reason))."""
+    counter(
+        "rnn_dispatch_total",
+        "RNN lowering decisions by tier (trace-time; reason labels "
+        "match the one-time fallback warnings)",
+    ).inc(kind=kind, path=path, reason=reason)
+
+
+def _warn_scan_fallback(kind: str, b: int, h: int) -> str:
     """One-time structured warning when a default-activation sequence
     that WOULD use a fused Pallas kernel falls back to the lax.scan
     path (VERDICT: the old H ≤ 512 VMEM gate used to be silent, hiding
     the un-fused gap at the baseline's own hidden=1280 row — that row
     now runs the round-8 blocked tier, so this warning marks truly
     off-tile shapes or a disabled blocked tier).  Keyed per (kind, B,
-    H) so a training loop logs each distinct shape once."""
-    key = (kind, b, h)
-    if key in _fallback_warned:
-        return
-    _fallback_warned.add(key)
-    from ..utils import FLAGS
-    if b % 8:
-        reason = "batch not a multiple of 8 (sublane tiling)"
-    elif h % 128:
-        reason = "hidden not a multiple of 128 (lane tiling)"
-    elif h > 512 and not FLAGS.fused_rnn_hblock:
-        reason = ("hidden>512 with the blocked tier disabled "
-                  "(--fused_rnn_hblock=false)")
-    else:
-        reason = ("hidden>512 and past even the blocked tier's "
-                  "streamed-VMEM budget")
-    _log.warning(
+    H) so a training loop logs each distinct shape once; returns the
+    reason label."""
+    reason = _fallback_reason(b, h)
+    warn_once(
+        f"fused_{kind}_fallback:{b}x{h}",
         "fused_%s_fallback: scan path taken for batch=%d hidden=%d "
         "(%s); throughput is the pre-fusion tier — see "
         "bench.py::bench_lstm_1280 for the measured gap", kind, b, h,
-        reason)
+        reason, logger=_log)
+    return reason
 
 _UNROLL = 4  # measured sweet spot for the sequential phase (see module doc)
 
@@ -171,10 +189,13 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
         # the second predicate call below: it is the monkeypatch kill
         # point every equivalence test uses to force the scan reference
         if not fused_ok(b, h_dim):
-            _warn_scan_fallback("lstm", b, h_dim)
+            _record_dispatch("lstm", b, h_dim, "scan",
+                             _warn_scan_fallback("lstm", b, h_dim))
         else:
+            tier = fused_tier(b, h_dim) or "fused"
+            _record_dispatch("lstm", b, h_dim, tier)
             fn = lstm_fused_sequence_blocked \
-                if fused_tier(b, h_dim) == "fused_blocked" \
+                if tier == "fused_blocked" \
                 else lstm_fused_sequence
             y, cy, fh, fc = fn(
                 xw, mask, w_hh, check_i, check_f, check_o, h0, c0)
@@ -183,6 +204,9 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
             if return_cells:
                 return pack(y), final, pack(cy)
             return pack(y), final
+    else:
+        _record_dispatch("lstm", b, h_dim, "scan",
+                         "non-default activations")
 
     carry_dt = pol.output_dtype   # fp32 unless --bf16_activations
     init = LstmState(
@@ -245,10 +269,13 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
                                  gru_fused_sequence,
                                  gru_fused_sequence_blocked)
         if not fused_ok(b, h_dim):
-            _warn_scan_fallback("gru", b, h_dim)
+            _record_dispatch("gru", b, h_dim, "scan",
+                             _warn_scan_fallback("gru", b, h_dim))
         else:
+            tier = fused_tier(b, h_dim) or "fused"
+            _record_dispatch("gru", b, h_dim, tier)
             fn = gru_fused_sequence_blocked \
-                if fused_tier(b, h_dim) == "fused_blocked" \
+                if tier == "fused_blocked" \
                 else gru_fused_sequence
             y, fh = fn(xw, mask, w_hh[:, :2 * h_dim],
                        w_hh[:, 2 * h_dim:], h0)
@@ -257,6 +284,9 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
                 hs = hs[:, ::-1]
             return SequenceBatch(data=hs, length=seq.length), \
                 fh.astype(pol.output_dtype)
+    else:
+        _record_dispatch("gru", b, h_dim, "scan",
+                         "non-default activations")
 
     w_gates = w_hh[:, : 2 * h_dim].astype(cd)
     w_cand = w_hh[:, 2 * h_dim:].astype(cd)

@@ -17,11 +17,21 @@ import json
 import os
 from typing import Any, Dict
 
+import time
+
 import jax
 # explicit submodule import: pre-0.5 jax does not expose jax.export as
 # an attribute of the bare `import jax`
 import jax.export
 import numpy as np
+
+# telemetry is OPTIONAL here: paddle_tpu.observe.metrics is stdlib-only,
+# but a serving process that ships just this file (the capi-style
+# deployment story) runs fine without it
+try:
+    from ..observe import counter as _counter, histogram as _histogram
+except ImportError:  # standalone copy: no package context
+    _counter = _histogram = None
 
 
 class ServedModel:
@@ -64,6 +74,15 @@ class ServedModel:
                 raise ValueError(
                     f"feed {name!r}: shape {got} incompatible with {want}")
             args.append(a)
+        t0 = time.perf_counter()
         outs = self._exported.call(*args)
-        return {n: np.asarray(v)
-                for n, v in zip(self.fetch_names, outs)}
+        result = {n: np.asarray(v)
+                  for n, v in zip(self.fetch_names, outs)}
+        # np.asarray above synchronized the device, so this is true
+        # end-to-end inference latency
+        if _histogram is not None:
+            _histogram("serve_infer_seconds",
+                       "end-to-end ServedModel call latency").observe(
+                time.perf_counter() - t0)
+            _counter("serve_requests", "ServedModel calls served").inc()
+        return result

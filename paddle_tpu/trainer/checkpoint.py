@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..observe import counter, histogram
 from ..utils import FLAGS, PaddleTpuError, get_logger
 
 log = get_logger("checkpoint")
@@ -62,6 +63,7 @@ def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
     """
     final = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(save_dir, exist_ok=True)
+    t0 = time.perf_counter()
     tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-ckpt-")
     try:
         np.savez(os.path.join(tmp, "params.npz"),
@@ -91,6 +93,10 @@ def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
     except Exception:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    histogram("ckpt_save_seconds",
+              "wall time of one atomic checkpoint save (serialize + "
+              "digest + rename)").observe(time.perf_counter() - t0)
+    counter("ckpt_saves", "checkpoints saved").inc()
     log.info("saved checkpoint %s", final)
     sweep_retention(save_dir, keep)
     return final
@@ -187,7 +193,10 @@ def verify_checkpoint(ckpt_dir: str) -> bool:
     manifest, or a bare params.npz from an external tool) degrade to a
     structural check: the archives must exist and open as valid zips.
     """
-    return _verify_result(ckpt_dir) == "ok"
+    with histogram("ckpt_verify_seconds",
+                   "wall time of one checkpoint integrity verification "
+                   "(digest re-hash or structural check)").time():
+        return _verify_result(ckpt_dir) == "ok"
 
 
 def _pass_dirs(save_dir: str) -> List[str]:
@@ -215,6 +224,8 @@ def quarantine_checkpoint(ckpt_dir: str) -> Optional[str]:
     except OSError as e:
         log.warning("could not quarantine %s (%s)", ckpt_dir, e)
         return None
+    counter("ckpt_quarantined",
+            "corrupt checkpoint dirs renamed to .corrupt-*").inc()
     log.warning("quarantined corrupt checkpoint %s -> %s", ckpt_dir, target)
     return target
 
@@ -285,6 +296,9 @@ def sweep_retention(save_dir: str, keep: Optional[int] = None) -> List[str]:
             continue
         removed.append(path)
     if removed:
+        counter("ckpt_retention_removed",
+                "checkpoint/quarantine/orphan dirs reaped by the "
+                "retention sweep").inc(len(removed))
         log.info("retention sweep (keep=%d): removed %s", keep,
                  [os.path.basename(p) for p in removed])
     return removed

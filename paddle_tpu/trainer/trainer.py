@@ -28,6 +28,7 @@ from ..core.device import DATA_AXIS, data_sharding, get_mesh, replicated
 from ..core.sequence import SequenceBatch, value_of
 from ..layers.network import NeuralNetwork
 from ..optimizer import Optimizer, create_optimizer, make_schedule
+from .. import observe
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger, global_stat
 from . import events as ev
 from .checkpoint import (
@@ -262,23 +263,81 @@ class Trainer:
             out.append(ev)
         return out
 
+    def _count_recompiles(self) -> None:
+        """Tick ``jit_recompiles`` when the train step's jit cache grew.
+        The first entry is the initial compile; anything beyond one per
+        intended feed shape means shape churn is recompiling the hot
+        loop — the counter makes that visible without -jax_log_compiles
+        spelunking."""
+        try:
+            n = self._train_step._cache_size()
+        except (AttributeError, TypeError):
+            return
+        prev = getattr(self, "_jit_cache_size", 0)
+        if n > prev:
+            observe.counter(
+                "jit_recompiles",
+                "train-step XLA compiles (first compile included; >1 "
+                "per feed shape = recompile churn)").inc(n - prev)
+            self._jit_cache_size = n
+
     def train_one_batch(self, feed: Dict[str, Any]) -> float:
-        """``TrainerInternal::trainOneBatch`` equivalent (one jit call)."""
+        """``TrainerInternal::trainOneBatch`` equivalent (one jit call).
+
+        Telemetry: step latency lands in ``train_step_seconds`` split as
+        ``train_host_feed_seconds`` (shard/place the feed) + dispatch;
+        when a metrics sink is attached (``--metrics_jsonl``) the step
+        is additionally fenced with ``block_until_ready`` so
+        ``train_device_blocked_seconds`` captures true device time and
+        ``train_samples_per_sec`` is honest throughput — the Wang et
+        al. host-vs-device split.  With no sink the fence is skipped:
+        dispatch stays async and instrumentation is a few counter
+        increments.
+        """
         if self._train_step is None:
             self._train_step = self._build_train_step()
             self.params = self._place_params(self._dealias(self.params))
             self.opt_state = self._place_opt_state(
                 self._dealias(self.opt_state), self.params)
             self.buffers = self._replicate(self._dealias(self.buffers))
+        t0 = time.perf_counter()
         feed = self._shard_feed(feed)
         batch = _batch_size(feed)
         rng = jax.random.PRNGKey(
             (self.seed * 1000003 + self.samples_seen) % (2 ** 31))
+        t_feed = time.perf_counter()
         with global_stat.timer("train_batch"):
             self.params, self.opt_state, self.buffers, loss = \
                 self._train_step(self.params, self.opt_state, self.buffers,
                                  feed, rng,
                                  jnp.asarray(self.samples_seen, jnp.float32))
+        self._count_recompiles()
+        t_dispatch = time.perf_counter()
+        if observe.active():
+            jax.block_until_ready(loss)
+            t_done = time.perf_counter()
+            observe.histogram(
+                "train_device_blocked_seconds",
+                "time blocked on the device per step (fenced; only "
+                "recorded while a metrics sink is attached)"
+            ).observe(t_done - t_dispatch)
+            if t_done > t0:
+                observe.gauge(
+                    "train_samples_per_sec",
+                    "fenced per-step training throughput"
+                ).set(batch / (t_done - t0))
+        else:
+            t_done = t_dispatch
+        observe.histogram(
+            "train_host_feed_seconds",
+            "host time sharding/placing the feed per step"
+        ).observe(t_feed - t0)
+        observe.histogram(
+            "train_step_seconds",
+            "end-to-end train_one_batch latency (unfenced = dispatch "
+            "time unless a sink is attached)").observe(t_done - t0)
+        observe.counter("train_steps", "train steps executed").inc()
+        observe.counter("train_samples", "samples trained").inc(batch)
         self.samples_seen += batch
         return loss  # device scalar: don't block — caller decides when
 
@@ -288,14 +347,36 @@ class Trainer:
               feeder=None, test_reader=None,
               evaluators: Sequence = ()) -> None:
         event_handler = event_handler or _default_event_handler
+        observe.start_from_flags()   # --metrics_jsonl sink, if configured
+        wait_hist = observe.histogram(
+            "data_reader_wait_seconds",
+            "host time waiting on the reader per batch (input "
+            "pipeline stall)")
         for pass_id in range(FLAGS.start_pass, FLAGS.start_pass + num_passes):
             event_handler(ev.BeginPass(pass_id))
             last_loss = None
             batch_id = 0
-            for batch in reader():
+            # reader-wait vs train-time split per pass: the input-bound
+            # ratio is THE TPU-utilization diagnostic (Wang et al.,
+            # arXiv:1907.10701) — ~0 means compute-bound, → 1 means the
+            # chips starve on the input pipeline
+            wait_s = 0.0
+            busy_s = 0.0
+            batches = iter(reader())
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                dt = time.perf_counter() - t0
+                wait_s += dt
+                wait_hist.observe(dt)
                 event_handler(ev.BeginIteration(pass_id, batch_id))
+                t1 = time.perf_counter()
                 feed = feeder.convert(batch) if feeder else batch
                 loss = self.train_one_batch(feed)
+                busy_s += time.perf_counter() - t1
                 last_loss = loss
                 if FLAGS.log_period and (batch_id + 1) % FLAGS.log_period == 0:
                     event_handler(ev.EndIteration(
@@ -308,6 +389,12 @@ class Trainer:
                     log.info("parameter stats:\n%s",
                              parameter_stats(self.params))
                 batch_id += 1
+            if wait_s + busy_s > 0:
+                observe.gauge(
+                    "input_bound_ratio",
+                    "reader wait / (reader wait + feed+train time) of "
+                    "the last completed pass; ~0 compute-bound, "
+                    "→1 input-bound").set(wait_s / (wait_s + busy_s))
             metrics = {}
             if test_reader is not None:
                 res = self.test(test_reader, feeder, evaluators)

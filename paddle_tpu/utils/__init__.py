@@ -1,6 +1,6 @@
 from .error import ConfigError, PaddleTpuError, ShapeError, enforce, enforce_eq, layer_stack
 from .flags import FLAGS
-from .logger import get_logger
+from .logger import get_logger, reset_warn_once, set_log_level, warn_once
 from .registry import Registry
 from .stat import StatSet, global_stat
 
@@ -13,6 +13,9 @@ __all__ = [
     "layer_stack",
     "FLAGS",
     "get_logger",
+    "set_log_level",
+    "warn_once",
+    "reset_warn_once",
     "Registry",
     "StatSet",
     "global_stat",

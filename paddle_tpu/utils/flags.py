@@ -150,6 +150,20 @@ FLAGS.define("ckpt_verify", True,
              "manifest on load, and make resume scan backward past "
              "corrupt checkpoints (quarantined as .corrupt-*); off = "
              "the legacy blind latest-checkpoint load")
+FLAGS.define("log_level", "",
+             "framework log level: debug|info|warning|error|fatal "
+             "(empty = PADDLE_TPU_LOG_LEVEL env var, else INFO); "
+             "applied by the entry points after flag parsing via "
+             "utils.logger.set_log_level")
+FLAGS.define("metrics_jsonl", "",
+             "telemetry JSONL sink path: when set, a background "
+             "reporter appends one self-describing snapshot line "
+             "(typed metrics + StatSet timer table) every "
+             "--metrics_interval_s seconds (paddle_tpu/observe/); "
+             "empty = no sink, instrumentation stays near-zero cost "
+             "and the trainer skips its step-fencing time split")
+FLAGS.define("metrics_interval_s", 10.0,
+             "flush interval for the --metrics_jsonl reporter")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")

@@ -9,6 +9,11 @@ each layer's forward/backward in one of these, exactly like
 On TPU the async dispatch model means a timer around a jitted call measures
 dispatch unless the value is blocked on; ``timer(..., block_on=x)`` calls
 ``x.block_until_ready()`` before stopping the clock.
+
+Export: :meth:`StatSet.snapshot` returns a lock-consistent copy of the
+table — the :mod:`paddle_tpu.observe.report` reporter ships it on every
+JSONL line and into the Prometheus dump alongside the typed metrics, so
+timers and histograms share one export path.
 """
 
 from __future__ import annotations
@@ -27,16 +32,33 @@ class StatItem:
     total: float = 0.0
     max: float = 0.0
     min: float = float("inf")
+    # updates and snapshots race (timer threads vs the reporter flush
+    # thread); a per-item lock keeps count/total/max/min one consistent
+    # tuple instead of a field-by-field torn read
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def add(self, seconds: float) -> None:
-        self.count += 1
-        self.total += seconds
-        self.max = max(self.max, seconds)
-        self.min = min(self.min, seconds)
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.max = max(self.max, seconds)
+            self.min = min(self.min, seconds)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Lock-consistent copy: every field read under the same lock
+        acquisition, so count/total/avg always agree."""
+        with self._lock:
+            count, total = self.count, self.total
+            mx, mn = self.max, self.min
+        return {"name": self.name, "count": count, "total": total,
+                "avg": total / count if count else 0.0,
+                "max": mx, "min": mn if count else 0.0}
 
     @property
     def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
 
 
 class StatSet:
@@ -74,17 +96,26 @@ class StatSet:
         with self._lock:
             self._items.clear()
 
-    def print_all_status(self, log=print) -> None:
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """``{name: {count,total,avg,max,min}}`` — each item's fields
+        read atomically (the export path's view of the table)."""
         with self._lock:
-            items = sorted(self._items.values(), key=lambda i: -i.total)
-        if not items:
+            items = list(self._items.values())
+        return {it.name: it.snapshot() for it in items}
+
+    def print_all_status(self, log=print) -> None:
+        snaps = sorted(self.snapshot().values(),
+                       key=lambda s: -s["total"])
+        if not snaps:
             return
         log(f"======= StatSet: [{self.name}] status ======")
-        log(f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>10} {'max(ms)':>10}")
-        for it in items:
+        log(f"{'name':<40} {'calls':>8} {'total(ms)':>12} {'avg(ms)':>10} "
+            f"{'max(ms)':>10} {'min(ms)':>10}")
+        for s in snaps:
             log(
-                f"{it.name:<40} {it.count:>8} {it.total * 1e3:>12.2f} "
-                f"{it.avg * 1e3:>10.3f} {it.max * 1e3:>10.3f}"
+                f"{s['name']:<40} {s['count']:>8} "
+                f"{s['total'] * 1e3:>12.2f} {s['avg'] * 1e3:>10.3f} "
+                f"{s['max'] * 1e3:>10.3f} {s['min'] * 1e3:>10.3f}"
             )
 
 

@@ -55,20 +55,58 @@ def _fast_lane_elapsed(config):
     return time.perf_counter() - _SESSION_T0
 
 
+def _call_reports(tr):
+    return [r for key in ("passed", "failed")
+            for r in tr.stats.get(key, ())
+            if getattr(r, "when", None) == "call"]
+
+
+def _write_timing_artifact(tr, config):
+    """Ship the per-test timing table through the observe JSONL sink
+    (one self-describing line appended per session) so CI keeps a
+    machine-readable artifact of where the quick lane's budget goes —
+    the same schema the trainer's --metrics_jsonl lines use."""
+    path = os.environ.get("PADDLE_TPU_TEST_TIMINGS_JSONL",
+                          "/tmp/paddle_tpu_test_timings.jsonl")
+    reports = _call_reports(tr)
+    if not path or not reports:
+        return
+    try:
+        from paddle_tpu.observe import MetricsRegistry, MetricsReporter
+
+        reg = MetricsRegistry()
+        hist = reg.histogram(
+            "test_duration_seconds",
+            "distribution of per-test call durations this session")
+        per = reg.gauge("test_duration",
+                        "per-test call duration, labeled by node id")
+        for r in reports:
+            hist.observe(r.duration)
+            per.set(round(r.duration, 4), test=r.nodeid,
+                    outcome=r.outcome)
+        lane = reg.gauge("fast_lane", "quick-lane budget state")
+        elapsed = _fast_lane_elapsed(config)
+        if elapsed is not None:
+            lane.set(round(elapsed, 1), field="elapsed_s")
+            lane.set(FAST_LANE_BUDGET_S, field="budget_s")
+        MetricsReporter(path, registry=reg, stat=None).flush()
+    except Exception as e:   # noqa: BLE001 — never fail the run on it
+        tr.line(f"(timing artifact not written: {e})")
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    tr = terminalreporter
+    _write_timing_artifact(tr, config)
     elapsed = _fast_lane_elapsed(config)
     if elapsed is None or elapsed <= FAST_LANE_BUDGET_S:
         return
-    tr = terminalreporter
     tr.section("FAST-LANE BUDGET EXCEEDED", sep="=", red=True, bold=True)
     tr.line(f"the default quick lane (-m 'not slow') took {elapsed:.0f} s "
             f"> {FAST_LANE_BUDGET_S} s budget (round-6 reference: 278 s).")
     # name the offenders: the three slowest call phases, so the breach
     # points at the tests to mark slow instead of just announcing itself
-    reports = [r for key in ("passed", "failed")
-               for r in tr.stats.get(key, ())
-               if getattr(r, "when", None) == "call"]
-    for r in sorted(reports, key=lambda r: r.duration, reverse=True)[:3]:
+    for r in sorted(_call_reports(tr), key=lambda r: r.duration,
+                    reverse=True)[:3]:
         tr.line(f"  slowest: {r.duration:7.1f} s  {r.nodeid}")
     tr.line("Move heavyweight tests to @pytest.mark.slow or speed them "
             "up; set PADDLE_TPU_FAST_LANE_STRICT=1 to make this fail.")
@@ -109,6 +147,10 @@ def rng():
 @pytest.fixture(autouse=True)
 def _reset_global_state():
     yield
+    from paddle_tpu.observe import REGISTRY
+    from paddle_tpu.utils.logger import reset_warn_once
     from paddle_tpu.utils.stat import global_stat
 
     global_stat.reset()
+    REGISTRY.reset()
+    reset_warn_once()

@@ -499,7 +499,13 @@ def test_gauntlet_all_faults_one_run(tmp_path):
     transiently poisoned shard, two disk-full saves — the run completes
     all epochs, trains every sample at least once, and leaves a valid
     checkpoint that a fresh trainer resumes from (past an
-    injected-corrupt newer one)."""
+    injected-corrupt newer one).  The telemetry layer must have
+    WITNESSED the gauntlet: every injected fault family leaves its
+    counter nonzero (a silent recovery is indistinguishable from a
+    fault that never fired)."""
+    from paddle_tpu.observe import REGISTRY
+
+    c0 = REGISTRY.flat(kinds=("counter",))
     m = Master(timeout_s=0.5, failure_max=5)
     port = m.serve(0)
     c = _fast_client(port, retry_max=10)
@@ -528,3 +534,11 @@ def test_gauntlet_all_faults_one_run(tmp_path):
     assert tr2.samples_seen > 0
     assert os.path.basename(latest_valid_checkpoint(save_dir)) \
         != os.path.basename(newest)
+
+    c1 = REGISTRY.flat(kinds=("counter",))
+    delta = lambda k: c1.get(k, 0) - c0.get(k, 0)  # noqa: E731
+    assert delta("master_reconnects") > 0          # TCP drops re-dialed
+    assert delta("ckpt_quarantined") >= 1          # bitflip quarantined
+    assert delta("elastic_skipped_saves") == 2     # two disk-full windows
+    assert delta("ckpt_saves") >= 1                # and real saves landed
+    assert delta("train_steps") > 0
