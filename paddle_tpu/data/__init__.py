@@ -1,4 +1,4 @@
-from . import datasets, reader, recordio
+from . import datasets, pipeline, reader, recordio
 from .feeder import (
     DataFeeder,
     InputType,
@@ -13,9 +13,11 @@ from .feeder import (
     sparse_float_vector,
     sparse_float_vector_sequence,
 )
+from .pipeline import AsyncPipeline, prefetch_reader
 from .provider import provider
 
 __all__ = [
+    "AsyncPipeline",
     "DataFeeder",
     "InputType",
     "datasets",
@@ -25,6 +27,8 @@ __all__ = [
     "integer_value",
     "integer_value_sequence",
     "integer_value_sub_sequence",
+    "pipeline",
+    "prefetch_reader",
     "provider",
     "reader",
     "sparse_binary_vector",

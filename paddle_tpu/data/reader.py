@@ -16,7 +16,36 @@ import random
 import threading
 from typing import Any, Callable, Iterable, List, Sequence
 
+from .pipeline import IO_THREAD_PREFIX
+
 Reader = Callable[[], Iterable[Any]]
+
+
+def _put_until(q: "queue.Queue", item: Any, stop: threading.Event,
+               poll_s: float = 0.05) -> bool:
+    """``q.put`` that gives up when ``stop`` is set — a producer thread
+    must never stay blocked against a full queue after its consumer
+    abandoned the generator.  Returns False when it gave up."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=poll_s)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _close_iter(it: Any) -> None:
+    """Close a (possibly generator) iterator, best-effort: propagates
+    GeneratorExit through reader chains so teardown contracts (e.g.
+    ``master_reader`` FAILing its in-flight lease) run deterministically
+    instead of waiting on GC."""
+    close = getattr(it, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
 
 
 def np_array(x) -> Reader:
@@ -88,7 +117,14 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
 
 def buffered(reader: Reader, size: int) -> Reader:
     """Double-buffering via a background thread — the TPU-host overlap
-    equivalent of ``DataProvider.h:360``'s double-buffer queue."""
+    equivalent of ``DataProvider.h:360``'s double-buffer queue.
+
+    A consumer that abandons the generator mid-pass (break / ``close()``
+    / GC → GeneratorExit) shuts the producer down and joins it: the
+    producer must not stay blocked on ``q.put`` against a full queue
+    forever (the classic thread leak), and the inner reader is closed so
+    its own teardown (lease FAILs, socket closes) runs.
+    """
 
     class _End:
         pass
@@ -97,25 +133,38 @@ def buffered(reader: Reader, size: int) -> Reader:
         q: "queue.Queue" = queue.Queue(maxsize=size)
 
         error: List[BaseException] = []
+        stop = threading.Event()
 
         def producer():
+            it = None
             try:
-                for e in reader():
-                    q.put(e)
+                # inside the try: a reader that raises EAGERLY (before
+                # returning its iterable) must still reach the consumer
+                it = iter(reader())
+                for e in it:
+                    if not _put_until(q, e, stop):
+                        return               # consumer gone
             except BaseException as exc:  # re-raised in the consumer
                 error.append(exc)
             finally:
-                q.put(_End)
+                if it is not None:
+                    _close_iter(it)
+                _put_until(q, _End, stop)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name=IO_THREAD_PREFIX + "buffered")
         t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                if error:
-                    raise error[0]
-                break
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    if error:
+                        raise error[0]
+                    break
+                yield e
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
 
     return buffered_reader
 
@@ -150,7 +199,14 @@ def cache(reader: Reader) -> Reader:
 def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
                  buffer_size: int, order: bool = False) -> Reader:
     """Parallel map over a reader with worker threads (reference uses
-    threads too — CPython-level parallelism for IO/numpy work)."""
+    threads too — CPython-level parallelism for IO/numpy work).
+
+    Fault contract: an exception in ``mapper`` or in the feed thread is
+    caught, recorded, and re-raised in the consumer — the dying thread
+    still delivers its ``_End`` so the consumer never blocks forever on
+    ``out_q.get()`` (the pre-round-11 hang).  A consumer that abandons
+    the generator mid-pass shuts down and joins the threads.
+    """
 
     class _End:
         pass
@@ -158,51 +214,92 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
     def xreader():
         in_q: "queue.Queue" = queue.Queue(buffer_size)
         out_q: "queue.Queue" = queue.Queue(buffer_size)
+        error: List[BaseException] = []
+        stop = threading.Event()
 
         def feed():
-            for i, e in enumerate(reader()):
-                in_q.put((i, e))
-            for _ in range(process_num):
-                in_q.put(_End)
+            it = None
+            try:
+                # inside the try: an eagerly-raising reader must still
+                # deliver the _End markers below, or the consumer wedges
+                it = iter(reader())
+                for i, e in enumerate(it):
+                    if not _put_until(in_q, (i, e), stop):
+                        return               # consumer gone
+            except BaseException as exc:  # re-raised in the consumer
+                error.append(exc)
+            finally:
+                if it is not None:
+                    _close_iter(it)
+                # every worker gets its end marker even when the source
+                # died mid-pass — a missing _End wedges the consumer
+                for _ in range(process_num):
+                    if not _put_until(in_q, _End, stop):
+                        return
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is _End:
-                    out_q.put(_End)
-                    return
-                i, e = item
-                out_q.put((i, mapper(e)))
+            try:
+                while True:
+                    try:
+                        item = in_q.get(timeout=0.05)
+                    except queue.Empty:
+                        if stop.is_set():
+                            return
+                        continue
+                    if item is _End:
+                        _put_until(out_q, _End, stop)
+                        return
+                    i, e = item
+                    if not _put_until(out_q, (i, mapper(e)), stop):
+                        return
+            except BaseException as exc:  # re-raised in the consumer
+                error.append(exc)
+                _put_until(out_q, _End, stop)
 
-        threading.Thread(target=feed, daemon=True).start()
-        workers = [threading.Thread(target=work, daemon=True)
-                   for _ in range(process_num)]
-        for w in workers:
-            w.start()
-        finished = 0
-        if order:
-            pending = {}
-            next_i = 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                i, e = item
-                pending[i] = e
+        threads = [threading.Thread(target=feed, daemon=True,
+                                    name=IO_THREAD_PREFIX + "xmap-feed")]
+        threads += [threading.Thread(target=work, daemon=True,
+                                     name=f"{IO_THREAD_PREFIX}xmap-w{i}")
+                    for i in range(process_num)]
+        for t in threads:
+            t.start()
+        try:
+            finished = 0
+            if order:
+                pending = {}
+                next_i = 0
+                while finished < process_num:
+                    if error:     # fault: stop draining NOW, not after
+                        raise error[0]   # the rest of the stream maps
+                    item = out_q.get()
+                    if item is _End:
+                        finished += 1
+                        continue
+                    i, e = item
+                    pending[i] = e
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+                if error:
+                    raise error[0]
                 while next_i in pending:
                     yield pending.pop(next_i)
                     next_i += 1
-            while next_i in pending:
-                yield pending.pop(next_i)
-                next_i += 1
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                yield item[1]
+            else:
+                while finished < process_num:
+                    if error:
+                        raise error[0]
+                    item = out_q.get()
+                    if item is _End:
+                        finished += 1
+                        continue
+                    yield item[1]
+                if error:
+                    raise error[0]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
 
     return xreader
 
@@ -244,7 +341,8 @@ def recordio(paths, buf_size: int = 100) -> Reader:
     return buffered(reader, buf_size)
 
 
-def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
+def cloud_reader(paths, master, buf_size: int = 64,
+                 read_ahead: int = 2) -> Reader:
     """Master-coordinated distributed reader (``creator.py:91``): the
     master leases recordio *chunks* to trainers so each record is
     consumed once per pass cluster-wide, with failed leases re-queued.
@@ -252,6 +350,11 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
     :param master: a :class:`paddle_tpu.distributed.Master` /
         ``MasterClient`` (replaces the reference's etcd endpoint — no
         external coordinator in the TPU build).
+    :param read_ahead: chunks the lease/fetch thread keeps ahead of
+        training, so the next chunk's disk read + unpickle overlaps the
+        current chunk's steps (``master_reader(read_ahead=...)``); 0
+        restores the fetch-on-demand path.  Leases still FAIL on
+        abandonment — including prefetched-but-unconsumed chunks.
     """
     import pickle
 
@@ -271,7 +374,8 @@ def cloud_reader(paths, master, buf_size: int = 64) -> Reader:
 
     # the shared client outlives each pass's generator: don't let
     # master_reader's teardown close it between passes
-    inner = master_reader(master, load_chunk, close_client=False)
+    inner = master_reader(master, load_chunk, close_client=False,
+                          read_ahead=read_ahead)
     # offset the local pass counter by the master's epoch so a trainer
     # (re)joining a long-lived or snapshot-recovered master doesn't send
     # reset requests the master has already performed

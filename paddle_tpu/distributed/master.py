@@ -12,14 +12,16 @@ from __future__ import annotations
 import ctypes
 import itertools
 import os
+import queue
 import random
 import socket
 import subprocess
+import threading
 import time
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
-from ..observe import counter
+from ..observe import counter, gauge
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger
 
 log = get_logger("master")
@@ -346,7 +348,7 @@ class MasterClient:
 
 
 def master_reader(client, load_fn, wait_sleep: float = 0.05,
-                  close_client: bool = True):
+                  close_client: bool = True, read_ahead: int = 0):
     """Reader pulling task payloads from a master and yielding samples —
     the ``cloud_reader`` equivalent (``python/paddle/v2/reader/creator.py:91``).
 
@@ -366,7 +368,21 @@ def master_reader(client, load_fn, wait_sleep: float = 0.05,
     for a shared client whose lifecycle is managed elsewhere (e.g.
     ``cloud_reader``'s multi-pass wrapper — the lease FAIL on
     abandonment still happens).
+
+    ``read_ahead > 0`` overlaps the NEXT chunk's lease + ``load_fn``
+    fetch with consumption of the current one: a background thread
+    leases tasks and materializes their samples into a queue at most
+    ``read_ahead`` chunks deep (see :func:`_readahead_reader`).  The
+    lease contract is unchanged — FIN only after the chunk's samples
+    were all consumed, FAIL on a load fault or on abandonment, for
+    every chunk the prefetcher holds (queued, in flight, or being
+    consumed).  The client survives master reconnects mid-prefetch
+    exactly as in the synchronous path (``_call`` replays).
     """
+
+    if read_ahead > 0:
+        return _readahead_reader(client, load_fn, wait_sleep,
+                                 close_client, read_ahead)
 
     def reader():
         open_tid = None                    # leased, not yet FIN/FAILed
@@ -399,5 +415,124 @@ def master_reader(client, load_fn, wait_sleep: float = 0.05,
                 if close is not None:
                     close()
             raise
+
+    return reader
+
+
+def _readahead_reader(client, load_fn, wait_sleep: float,
+                      close_client: bool, depth: int):
+    """``master_reader`` with chunk read-ahead: a background thread
+    leases the next task and materializes its samples while the trainer
+    consumes the current chunk, so shard fetch (network/disk IO in
+    ``load_fn``) overlaps training instead of stalling each chunk
+    boundary.
+
+    Lease lifecycle is identical to the synchronous path, just tracked
+    for every chunk the prefetcher holds: FIN after the chunk's last
+    sample was consumed; FAIL on a load fault (which then re-raises in
+    the consumer, so retry loops re-enter the reader) and on
+    abandonment — a torn-down generator FAILs the chunk being consumed
+    AND every prefetched-but-unconsumed chunk, so peers re-lease them
+    immediately instead of waiting out the server-side timeout.  All
+    client calls (two threads share one socket) are serialized under a
+    lock; master reconnects inside ``_call`` replay as usual, so the
+    prefetcher rides through connection drops.
+
+    Note: a prefetched chunk's lease ages while it waits in the queue —
+    keep ``read_ahead × chunk-train-time`` well under the master's
+    lease ``timeout_s`` or leases re-queue spuriously (at-least-once
+    still holds; samples may train twice).
+    """
+    from ..data.pipeline import IO_THREAD_PREFIX
+    from ..data.reader import _put_until
+
+    _End = object()
+    depth_gauge = gauge(
+        "cloud_readahead_depth",
+        "prefetched chunks waiting in the cloud reader's read-ahead "
+        "queue")
+    chunk_counter = counter(
+        "cloud_readahead_chunks_total",
+        "chunks fetched by the cloud reader's read-ahead thread")
+
+    def reader():
+        out_q: "queue.Queue" = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+        error: List[BaseException] = []
+        call_lock = threading.Lock()   # one socket, two threads
+        tids_lock = threading.Lock()
+        open_tids: set = set()         # leased, not yet FIN/FAILed
+
+        def _put(item) -> bool:
+            return _put_until(out_q, item, stop)
+
+        def fetcher():
+            try:
+                while not stop.is_set():
+                    with call_lock:
+                        tid, payload = client.get_task()
+                    if payload is None:
+                        if tid == 1:           # all leased elsewhere
+                            time.sleep(wait_sleep)
+                            continue
+                        break                  # epoch done
+                    with tids_lock:
+                        open_tids.add(tid)
+                    try:
+                        samples = list(load_fn(payload))
+                    except Exception as exc:   # shard fault: re-queue,
+                        with tids_lock:        # then re-raise consumer-
+                            open_tids.discard(tid)  # side
+                        with call_lock:
+                            client.task_failed(tid)
+                        error.append(exc)
+                        break
+                    chunk_counter.inc()
+                    if not _put((tid, samples)):
+                        return                 # consumer gone
+                    depth_gauge.set(out_q.qsize())
+            except BaseException as exc:  # noqa: BLE001 — incl. RPC
+                error.append(exc)         # giveups: consumer re-raises
+            finally:
+                _put(_End)
+
+        t = threading.Thread(target=fetcher, daemon=True,
+                             name=IO_THREAD_PREFIX + "cloud-readahead")
+        t.start()
+        abandoned = False
+        try:
+            while True:
+                item = out_q.get()
+                if item is _End:
+                    if error:
+                        raise error[0]
+                    return
+                tid, samples = item
+                depth_gauge.set(out_q.qsize())
+                for sample in samples:
+                    yield sample
+                with call_lock:
+                    client.task_finished(tid)  # fully consumed
+                with tids_lock:
+                    open_tids.discard(tid)
+        except GeneratorExit:
+            abandoned = True
+            raise
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+            with tids_lock:
+                leftovers = sorted(open_tids)
+                open_tids.clear()
+            for tid in leftovers:   # re-queue: consumed-not-FINed chunk
+                try:                # + every prefetched-unconsumed one
+                    with call_lock:
+                        client.task_failed(tid)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            if abandoned and close_client:
+                close = getattr(client, "close", None)
+                if close is not None:
+                    close()
 
     return reader

@@ -9,7 +9,10 @@ Instrumented surfaces (all against :data:`REGISTRY`):
 
 - trainer: step latency split host-feed vs device-blocked, samples/sec,
   jit recompiles (``paddle_tpu/trainer/trainer.py``);
-- data path: reader wait + feed-convert time → input-bound ratio;
+- data path: input wait (reader or prefetch queue) + feed-convert time
+  → input-bound ratio; async-pipeline queue depth, prefetch hit/stall
+  census, worker convert time, cloud read-ahead depth/chunks
+  (``paddle_tpu/data/pipeline.py``, ``distributed/master.py``);
 - dispatch tiers: RNN fused_blocked/fused/scan with fallback reasons,
   conv+BN fused/chain/unfused (``ops/recurrent_ops.py``,
   ``ops/nn_ops.py``), build-time fused-pair census

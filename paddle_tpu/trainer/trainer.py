@@ -131,6 +131,39 @@ class Trainer:
         return {k: jax.tree_util.tree_map(place, v)
                 for k, v in feed.items()}
 
+    def _place_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
+        """Shard/place a converted feed on the CALLING thread.
+
+        The async input pipeline runs this on its worker threads so the
+        host→device copy overlaps the running step; the result is
+        handed to ``train_one_batch(..., placed=True)`` which then
+        skips its own ``_shard_feed``.  On a single-device mesh
+        ``_shard_feed`` is the identity, so leaves are committed with
+        ``jnp.asarray`` here — otherwise a numpy feed would pay its
+        H2D transfer inside the jit dispatch, on the critical path."""
+        feed = self._shard_feed(feed)
+        if self.mesh.shape.get(DATA_AXIS, 1) <= 1:
+            feed = {k: jax.tree_util.tree_map(jnp.asarray, v)
+                    for k, v in feed.items()}
+        return feed
+
+    def _pipeline_or_sync(self, reader, feeder):
+        """Build this pass's batch source: an :class:`AsyncPipeline`
+        (convert + device placement on worker threads) when
+        ``--prefetch_depth`` > 0, else the raw reader iterator.
+        Returns ``(iterable, pipe)`` — ``pipe`` is None on the
+        synchronous path and must be ``close()``d otherwise."""
+        depth = max(0, int(FLAGS.prefetch_depth))
+        if depth == 0:
+            return iter(reader()), None
+        from ..data.pipeline import AsyncPipeline
+        pipe = AsyncPipeline(
+            reader(),
+            convert_fn=feeder.convert if feeder else None,
+            place_fn=self._place_feed,
+            depth=depth, workers=FLAGS.reader_workers)
+        return pipe, pipe
+
     def _replicate(self, tree):
         if self.mesh.devices.size <= 1:
             return tree
@@ -281,8 +314,14 @@ class Trainer:
                 "per feed shape = recompile churn)").inc(n - prev)
             self._jit_cache_size = n
 
-    def train_one_batch(self, feed: Dict[str, Any]) -> float:
+    def train_one_batch(self, feed: Dict[str, Any],
+                        placed: bool = False) -> float:
         """``TrainerInternal::trainOneBatch`` equivalent (one jit call).
+
+        ``placed=True`` marks a feed the async input pipeline already
+        sharded/placed on a worker thread (``_place_feed``) — the
+        step skips its own ``_shard_feed`` so no placement work is
+        repeated (and multihost feeds aren't re-globalized).
 
         Telemetry: step latency lands in ``train_step_seconds`` split as
         ``train_host_feed_seconds`` (shard/place the feed) + dispatch;
@@ -301,7 +340,8 @@ class Trainer:
                 self._dealias(self.opt_state), self.params)
             self.buffers = self._replicate(self._dealias(self.buffers))
         t0 = time.perf_counter()
-        feed = self._shard_feed(feed)
+        if not placed:
+            feed = self._shard_feed(feed)
         batch = _batch_size(feed)
         rng = jax.random.PRNGKey(
             (self.seed * 1000003 + self.samples_seen) % (2 ** 31))
@@ -350,51 +390,68 @@ class Trainer:
         observe.start_from_flags()   # --metrics_jsonl sink, if configured
         wait_hist = observe.histogram(
             "data_reader_wait_seconds",
-            "host time waiting on the reader per batch (input "
-            "pipeline stall)")
+            "host time blocked on input per batch: the raw reader on "
+            "the synchronous path, the prefetch queue when the async "
+            "pipeline is on (--prefetch_depth > 0) — an input-pipeline "
+            "stall either way")
         for pass_id in range(FLAGS.start_pass, FLAGS.start_pass + num_passes):
             event_handler(ev.BeginPass(pass_id))
             last_loss = None
             batch_id = 0
-            # reader-wait vs train-time split per pass: the input-bound
+            # input-wait vs train-time split per pass: the input-bound
             # ratio is THE TPU-utilization diagnostic (Wang et al.,
             # arXiv:1907.10701) — ~0 means compute-bound, → 1 means the
-            # chips starve on the input pipeline
+            # chips starve on the input pipeline.  With the async
+            # pipeline on, reader IO + convert + H2D run on worker
+            # threads and `wait` is the queue-get stall, so the ratio
+            # keeps meaning "host input work the step had to wait for".
             wait_s = 0.0
             busy_s = 0.0
-            batches = iter(reader())
-            while True:
-                t0 = time.perf_counter()
-                try:
-                    batch = next(batches)
-                except StopIteration:
-                    break
-                dt = time.perf_counter() - t0
-                wait_s += dt
-                wait_hist.observe(dt)
-                event_handler(ev.BeginIteration(pass_id, batch_id))
-                t1 = time.perf_counter()
-                feed = feeder.convert(batch) if feeder else batch
-                loss = self.train_one_batch(feed)
-                busy_s += time.perf_counter() - t1
-                last_loss = loss
-                if FLAGS.log_period and (batch_id + 1) % FLAGS.log_period == 0:
-                    event_handler(ev.EndIteration(
-                        pass_id=pass_id, batch_id=batch_id,
-                        cost=float(loss)))
-                if FLAGS.show_parameter_stats_period and \
-                        (batch_id + 1) % \
-                        FLAGS.show_parameter_stats_period == 0:
-                    from ..utils.profiler import parameter_stats
-                    log.info("parameter stats:\n%s",
-                             parameter_stats(self.params))
-                batch_id += 1
+            src, pipe = self._pipeline_or_sync(reader, feeder)
+            batches = iter(src)
+            try:
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(batches)
+                    except StopIteration:
+                        break
+                    dt = time.perf_counter() - t0
+                    wait_s += dt
+                    wait_hist.observe(dt)
+                    event_handler(ev.BeginIteration(pass_id, batch_id))
+                    t1 = time.perf_counter()
+                    if pipe is not None:      # converted+placed upstream
+                        feed = batch
+                    else:
+                        feed = feeder.convert(batch) if feeder else batch
+                    loss = self.train_one_batch(feed,
+                                                placed=pipe is not None)
+                    busy_s += time.perf_counter() - t1
+                    last_loss = loss
+                    if FLAGS.log_period and \
+                            (batch_id + 1) % FLAGS.log_period == 0:
+                        event_handler(ev.EndIteration(
+                            pass_id=pass_id, batch_id=batch_id,
+                            cost=float(loss)))
+                    if FLAGS.show_parameter_stats_period and \
+                            (batch_id + 1) % \
+                            FLAGS.show_parameter_stats_period == 0:
+                        from ..utils.profiler import parameter_stats
+                        log.info("parameter stats:\n%s",
+                                 parameter_stats(self.params))
+                    batch_id += 1
+            finally:
+                if pipe is not None:
+                    pipe.close()
             if wait_s + busy_s > 0:
                 observe.gauge(
                     "input_bound_ratio",
-                    "reader wait / (reader wait + feed+train time) of "
-                    "the last completed pass; ~0 compute-bound, "
-                    "→1 input-bound").set(wait_s / (wait_s + busy_s))
+                    "input wait / (input wait + train time) of the "
+                    "last completed pass — reader wait on the sync "
+                    "path, prefetch-queue wait with the async "
+                    "pipeline; ~0 compute-bound, →1 input-bound"
+                ).set(wait_s / (wait_s + busy_s))
             metrics = {}
             if test_reader is not None:
                 res = self.test(test_reader, feeder, evaluators)
@@ -411,7 +468,9 @@ class Trainer:
              label_name: str = "label") -> Dict[str, float]:
         """``Tester::test`` equivalent.  With no explicit ``evaluators``,
         the model config's declared evaluators run (the v1
-        ``*_evaluator(...)`` config calls)."""
+        ``*_evaluator(...)`` config calls).  Shares the async input
+        pipeline with ``train`` (``--prefetch_depth``): convert +
+        device placement overlap the eval steps."""
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         if not evaluators:
@@ -420,40 +479,50 @@ class Trainer:
         eval_names = self._eval_output_names() if evaluators else []
         for e in evaluators:
             e.start()
-        for batch in reader():
-            feed = feeder.convert(batch) if feeder else batch
-            feed = self._shard_feed(feed)
-            loss, outputs = self._eval_step(self.params, self.buffers, feed)
-            b = _batch_size(feed)
-            total += float(loss) * b
-            n += b
-            if evaluators:
-                # prefer the prediction layer over the cost output
-                out0 = outputs.get(eval_names[0]) if eval_names else None
-                if out0 is None:
-                    out0 = next(iter(outputs.values()))
-                for e in evaluators:
-                    entry = getattr(e, "_config_entry", None)
-                    if entry:
-                        ein = outputs.get(entry["input_layer_name"])
-                        if ein is None:
-                            log.warning(
-                                "evaluator %s: input layer %r not in "
-                                "eval outputs; skipping",
-                                entry.get("name"),
-                                entry["input_layer_name"])
-                            continue
-                        elab = feed.get(entry.get("label_layer_name",
-                                                  label_name))
-                        w = feed.get(entry["weight_layer_name"]) \
-                            if entry.get("weight_layer_name") else None
-                        if w is not None and "weight" in \
-                                e.eval_batch.__code__.co_varnames:
-                            e.eval_batch(ein, elab, weight=w)
+        src, pipe = self._pipeline_or_sync(reader, feeder)
+        try:
+            for batch in src:
+                if pipe is not None:        # converted+placed upstream
+                    feed = batch
+                else:
+                    feed = feeder.convert(batch) if feeder else batch
+                    feed = self._shard_feed(feed)
+                loss, outputs = self._eval_step(self.params, self.buffers,
+                                                feed)
+                b = _batch_size(feed)
+                total += float(loss) * b
+                n += b
+                if evaluators:
+                    # prefer the prediction layer over the cost output
+                    out0 = outputs.get(eval_names[0]) if eval_names \
+                        else None
+                    if out0 is None:
+                        out0 = next(iter(outputs.values()))
+                    for e in evaluators:
+                        entry = getattr(e, "_config_entry", None)
+                        if entry:
+                            ein = outputs.get(entry["input_layer_name"])
+                            if ein is None:
+                                log.warning(
+                                    "evaluator %s: input layer %r not in "
+                                    "eval outputs; skipping",
+                                    entry.get("name"),
+                                    entry["input_layer_name"])
+                                continue
+                            elab = feed.get(entry.get("label_layer_name",
+                                                      label_name))
+                            w = feed.get(entry["weight_layer_name"]) \
+                                if entry.get("weight_layer_name") else None
+                            if w is not None and "weight" in \
+                                    e.eval_batch.__code__.co_varnames:
+                                e.eval_batch(ein, elab, weight=w)
+                            else:
+                                e.eval_batch(ein, elab)
                         else:
-                            e.eval_batch(ein, elab)
-                    else:
-                        e.eval_batch(out0, feed.get(label_name))
+                            e.eval_batch(out0, feed.get(label_name))
+        finally:
+            if pipe is not None:
+                pipe.close()
         metrics = {"test_cost": total / max(n, 1)}
         for e in evaluators:
             vals = e.finish()

@@ -165,7 +165,17 @@ FLAGS.define("metrics_jsonl", "",
 FLAGS.define("metrics_interval_s", 10.0,
              "flush interval for the --metrics_jsonl reporter")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
-FLAGS.define("prefetch_depth", 2, "device prefetch queue depth for input batches")
+FLAGS.define("prefetch_depth", 2,
+             "async input pipeline depth (data/pipeline.py): max "
+             "batches in flight between the reader and the train step "
+             "— reader IO, DataFeeder.convert, and the host->device "
+             "transfer run on worker threads and overlap the running "
+             "step; 0 restores the fully synchronous loop "
+             "(read -> convert -> step, byte-for-byte)")
+FLAGS.define("reader_workers", 2,
+             "reader/convert worker threads per async input pipeline "
+             "(clamped to prefetch_depth; reading from the source is "
+             "serialized, convert+transfer parallelize)")
 FLAGS.define("parallel_nn", False, "per-layer device placement (sharding annotations)")
 FLAGS.define("enable_timers", True, "collect named wall timers (Stat.h equivalent)")
 FLAGS.define("port", 7164, "data-task coordinator service port")

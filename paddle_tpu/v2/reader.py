@@ -1,3 +1,4 @@
+from ..data.pipeline import prefetch_reader  # noqa: F401
 from ..data.reader import (  # noqa: F401
     batch,
     buffered,

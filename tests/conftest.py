@@ -154,3 +154,42 @@ def _reset_global_state():
     global_stat.reset()
     REGISTRY.reset()
     reset_warn_once()
+
+
+# Thread-leak guard: every pipeline/reader worker thread the framework
+# starts is named with the IO_THREAD_PREFIX ("ptpu-io-"); after each
+# test none may still be alive — a stray worker means a reader/pipeline
+# teardown path regressed (the exact class of bug the round-11 buffered/
+# xmap fixes close).  Default is a LOUD warning (a slow box can race a
+# join); set PADDLE_TPU_THREAD_GUARD_STRICT=1 to fail the test instead
+# — the same escalation contract as the fast-lane budget guard.
+_THREAD_GUARD_GRACE_S = 2.0
+
+
+@pytest.fixture(autouse=True)
+def _io_thread_leak_guard(request):
+    import threading
+    import warnings
+
+    from paddle_tpu.data.pipeline import IO_THREAD_PREFIX
+
+    def stray():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith(IO_THREAD_PREFIX)]
+
+    yield
+    deadline = time.perf_counter() + _THREAD_GUARD_GRACE_S
+    leaked = stray()
+    while leaked and time.perf_counter() < deadline:
+        time.sleep(0.02)     # drain in-flight joins before judging
+        leaked = stray()
+    if not leaked:
+        return
+    msg = (f"STRAY IO THREADS after {request.node.nodeid}: "
+           f"{sorted(t.name for t in leaked)} — a pipeline/reader "
+           "worker outlived its generator (leaked producer or missing "
+           "close()); set PADDLE_TPU_THREAD_GUARD_STRICT=1 to fail on "
+           "this")
+    if os.environ.get("PADDLE_TPU_THREAD_GUARD_STRICT") == "1":
+        pytest.fail(msg)
+    warnings.warn(msg)

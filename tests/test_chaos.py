@@ -190,6 +190,109 @@ def test_master_reader_closes_client_on_abandonment():
     c2.close()
 
 
+# ------------------------------------------- cloud read-ahead prefetcher
+def test_cloud_prefetch_survives_master_reconnect_mid_pass():
+    """Connection drops while the read-ahead thread is leasing/fetching
+    ahead of training: the client re-dials and replays under its own
+    lock, the pass completes with every sample exactly once, and no
+    lease is left pending."""
+    from paddle_tpu.observe import REGISTRY
+
+    c0 = REGISTRY.flat(kinds=("counter",))
+    m = Master(timeout_s=30, failure_max=3)
+    port = m.serve(0)
+    c = _fast_client(port, retry_max=10)
+    c.set_dataset([f"s{i}" for i in range(6)])
+    reader = master_reader(c, _load4, read_ahead=2)
+    with fault.drop_master_connection(c, every=3) as stats:
+        got = list(reader())
+    assert stats["dropped"] > 0
+    assert sorted(got) == sorted([(f"s{i}", j) for i in range(6)
+                                  for j in range(4)])
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0 and cnt["done"] == 6
+    c1 = REGISTRY.flat(kinds=("counter",))
+    assert c1.get("master_reconnects", 0) > c0.get("master_reconnects", 0)
+    assert c1.get("cloud_readahead_chunks_total", 0) \
+        - c0.get("cloud_readahead_chunks_total", 0) == 6
+    c.close()
+
+
+def test_cloud_prefetch_fails_all_held_leases_on_abandonment():
+    """A torn-down prefetching reader FAILs the chunk being consumed AND
+    every prefetched-but-unconsumed chunk, so peers re-lease them
+    immediately instead of waiting out the server-side timeout (the PR 4
+    lease contract, extended to the read-ahead queue)."""
+    m = Master(timeout_s=30, failure_max=3)   # long timeout: only FAIL
+    port = m.serve(0)                         # can re-queue promptly
+    c = _fast_client(port)
+    c.set_dataset([f"s{i}" for i in range(6)])
+    gen = master_reader(c, _load4, read_ahead=2)()
+    next(gen)
+    time.sleep(0.3)                           # let it lease ahead
+    gen.close()                               # abandoned mid-pass
+    cnt = m.counts()
+    assert cnt["pending"] == 0, cnt           # nothing burns a timeout
+    assert cnt["todo"] == 6 and cnt["done"] == 0, cnt
+    assert c._closed is True                  # no leaked master socket
+
+
+def test_cloud_prefetch_shard_fault_requeues_and_raises():
+    """A load fault in the read-ahead thread FAILs the lease and
+    re-raises consumer-side — retry loops re-enter the reader exactly
+    like the synchronous path."""
+    m = Master(timeout_s=1e6, failure_max=5)
+    port = m.serve(0)
+    c = _fast_client(port)
+    c.set_dataset(["good", "bad"])
+    poisoned = fault.poison_load_fn(_load4, ["bad"], times=1)
+    reader = master_reader(c, poisoned, read_ahead=2)
+    seen = []
+    for _ in range(2):                        # poison-retry loop
+        try:
+            seen.extend(reader())
+        except fault.ShardFault:
+            continue
+        break
+    assert poisoned.hits == {"bad": 1}
+    # every sample of both shards consumed at least once
+    assert {p for p, _ in seen} == {"good", "bad"}
+    assert len(seen) >= 8
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0
+    c.close()
+
+
+def test_gauntlet_with_prefetch_enabled(tmp_path):
+    """The async input pipeline layered over the master-leased reader,
+    with connection drops firing mid-prefetch: training completes, every
+    sample trains at least once, and the pipeline tears down clean."""
+    from paddle_tpu.data.pipeline import AsyncPipeline
+    from paddle_tpu.data.reader import batch as batch_reader
+
+    m = Master(timeout_s=30, failure_max=5)
+    port = m.serve(0)
+    c = _fast_client(port, retry_max=10)
+    c.set_dataset([f"s{i}" for i in range(5)])
+    tr, feeder = _tiny_trainer()
+    inner = master_reader(c, _shard_samples, read_ahead=2,
+                          close_client=False)
+    with fault.drop_master_connection(c, every=4, limit=4) as stats:
+        pipe = AsyncPipeline(batch_reader(inner, 8)(),
+                             convert_fn=feeder.convert,
+                             place_fn=tr._place_feed,
+                             depth=2, workers=2)
+        n = 0
+        for feed in pipe:
+            tr.train_one_batch(feed, placed=True)
+            n += 1
+    assert stats["dropped"] > 0
+    assert n == 5                             # 5 shards × 8 samples / 8
+    cnt = c.counts()
+    assert cnt["pending"] == 0 and cnt["failed"] == 0
+    c.close()
+
+
 # --------------------------------------------- master process kill/restart
 @pytest.mark.slow
 def test_master_kill_restart_client_reconnects(tmp_path):

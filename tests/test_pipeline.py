@@ -1,0 +1,371 @@
+"""Async input pipeline (`paddle_tpu/data/pipeline.py`) contracts.
+
+The pipeline's promises, each pinned: sample-order determinism vs the
+synchronous path (including the fixed-seed loss/param trajectory),
+exception propagation from worker threads at the position the fault
+occurred, clean shutdown on early break, `--prefetch_depth=0` restoring
+the synchronous loop exactly — plus regression tests for the two
+pre-round-11 reader-thread bugs (`xmap_readers` hang on mapper/feed
+faults, `buffered()` producer leak on consumer abandonment).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.data import reader as R
+from paddle_tpu.data.pipeline import (
+    IO_THREAD_PREFIX,
+    AsyncPipeline,
+    PipelineClosed,
+    prefetch_reader,
+)
+from paddle_tpu.utils import FLAGS
+
+
+def _io_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith(IO_THREAD_PREFIX)]
+
+
+def _wait_no_io_threads(budget_s: float = 3.0):
+    deadline = time.monotonic() + budget_s
+    while _io_threads() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not _io_threads(), [t.name for t in _io_threads()]
+
+
+@pytest.fixture
+def prefetch_flags():
+    """Save/restore the pipeline flags a test mutates."""
+    old = (FLAGS.prefetch_depth, FLAGS.reader_workers)
+    yield
+    FLAGS.set("prefetch_depth", old[0])
+    FLAGS.set("reader_workers", old[1])
+
+
+# ------------------------------------------------------------ ordering
+def test_order_deterministic_across_worker_counts():
+    """Batches come out in reader order no matter how many workers
+    convert them or how the convert latencies interleave."""
+    n = 60
+
+    def convert(b):
+        # index-dependent latency: late batches finish converting first
+        time.sleep(0.003 if b % 7 == 0 else 0.0)
+        return {"x": np.asarray([b])}
+
+    for workers in (1, 2, 4):
+        pipe = AsyncPipeline(iter(range(n)), convert_fn=convert,
+                             depth=4, workers=workers)
+        out = [int(f["x"][0]) for f in pipe]
+        assert out == list(range(n))
+    _wait_no_io_threads()
+
+
+def test_bounded_inflight():
+    """At most `depth` batches are pulled ahead of the consumer."""
+    pulled = []
+
+    def src():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+
+    pipe = AsyncPipeline(src(), depth=3, workers=2)
+    it = iter(pipe)
+    next(it)
+    time.sleep(0.3)          # give workers every chance to overrun
+    # 1 consumed + at most `depth` in flight (credit-bounded)
+    assert len(pulled) <= 1 + 3, pulled
+    it.close()
+    _wait_no_io_threads()
+
+
+# ------------------------------------------------- exception propagation
+def test_reader_exception_propagates_at_position():
+    def bad():
+        for i in range(10):
+            if i == 5:
+                raise ValueError("boom@5")
+            yield i
+
+    pipe = AsyncPipeline(bad(), depth=3, workers=3)
+    got = []
+    with pytest.raises(ValueError, match="boom@5"):
+        for x in pipe:
+            got.append(x)
+    assert got == [0, 1, 2, 3, 4]   # everything before the fault arrived
+    _wait_no_io_threads()
+
+
+def test_convert_exception_propagates_at_position():
+    pipe = AsyncPipeline(iter(range(10)),
+                         convert_fn=lambda x: 1 / (x - 3),
+                         depth=2, workers=2)
+    got = []
+    with pytest.raises(ZeroDivisionError):
+        for x in pipe:
+            got.append(x)
+    assert len(got) == 3
+    _wait_no_io_threads()
+
+
+# ------------------------------------------------------------- shutdown
+def test_early_break_joins_workers_and_closes_source():
+    state = {"closed": False}
+
+    def src():
+        try:
+            for i in range(10_000):
+                yield i
+        finally:
+            state["closed"] = True
+
+    pipe = AsyncPipeline(src(), depth=3, workers=3)
+    for i, _ in enumerate(pipe):
+        if i == 2:
+            break                    # abandons the generator → close()
+    _wait_no_io_threads()
+    assert state["closed"] is True   # GeneratorExit reached the source
+
+
+def test_close_is_idempotent_and_get_after_close_raises():
+    pipe = AsyncPipeline(iter(range(10)), depth=2, workers=2)
+    it = iter(pipe)
+    next(it)
+    pipe.close()
+    pipe.close()
+    with pytest.raises(PipelineClosed):
+        pipe.get()
+    _wait_no_io_threads()
+
+
+def test_exhaustion_then_stopiteration_only():
+    pipe = AsyncPipeline(iter(range(3)), depth=2, workers=2)
+    assert [pipe.get() for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(StopIteration):
+        pipe.get()
+    pipe.close()
+    _wait_no_io_threads()
+
+
+def test_prefetch_reader_wrapper_is_reinvocable():
+    r = prefetch_reader(lambda: iter(range(5)), depth=2, workers=2)
+    assert list(r()) == list(range(5))
+    assert list(r()) == list(range(5))
+    _wait_no_io_threads()
+
+
+# ----------------------------------------------------------- telemetry
+def test_pipeline_metrics_emitted():
+    def convert(b):
+        time.sleep(0.001)
+        return b
+
+    pipe = AsyncPipeline(iter(range(8)), convert_fn=convert,
+                         depth=2, workers=2)
+    list(pipe)
+    hits = observe.counter("pipeline_prefetch_hits_total").total()
+    stalls = observe.counter("pipeline_prefetch_stalls_total").total()
+    assert hits + stalls == 8
+    assert observe.histogram(
+        "pipeline_worker_convert_seconds").count() == 8
+    _wait_no_io_threads()
+
+
+# ------------------------------------------- trainer-level equivalence
+def _tiny_run(depth, data, seed=0):
+    from test_distributed import _tiny_trainer
+
+    FLAGS.set("prefetch_depth", depth)
+    tr, feeder = _tiny_trainer(seed=seed)
+    costs = []
+
+    def handler(e):
+        from paddle_tpu.trainer import events as ev
+        if isinstance(e, ev.EndPass):
+            costs.append(e.metrics["cost"])
+
+    old_save = FLAGS.save_dir
+    FLAGS.set("save_dir", "")
+    try:
+        tr.train(lambda: iter(data), num_passes=2, feeder=feeder,
+                 event_handler=handler)
+    finally:
+        FLAGS.set("save_dir", old_save)
+    return costs, tr.params
+
+
+def test_prefetch_zero_reproduces_synchronous_loop(prefetch_flags):
+    """Fixed-seed run: the async pipeline (depth>0) and the synchronous
+    path (depth=0) produce the identical loss trajectory AND identical
+    final parameters — prefetch only moves host work, never changes
+    what trains."""
+    rng = np.random.RandomState(3)
+    data = [[(rng.randn(8).astype(np.float32), int(rng.randint(0, 2)))
+             for _ in range(8)] for _ in range(10)]
+    costs0, params0 = _tiny_run(0, data)
+    costs2, params2 = _tiny_run(2, data)
+    assert costs0 == costs2
+    for k in params0:
+        np.testing.assert_array_equal(np.asarray(params0[k]),
+                                      np.asarray(params2[k]))
+    _wait_no_io_threads()
+
+
+def test_trainer_pipeline_sets_queue_wait_telemetry(prefetch_flags):
+    """With the pipeline on, data_reader_wait_seconds counts queue-get
+    waits and input_bound_ratio is still produced per pass."""
+    from test_distributed import _tiny_trainer
+
+    FLAGS.set("prefetch_depth", 2)
+    rng = np.random.RandomState(0)
+    data = [[(rng.randn(8).astype(np.float32), int(rng.randint(0, 2)))
+             for _ in range(8)] for _ in range(6)]
+    tr, feeder = _tiny_trainer()
+    old_save = FLAGS.save_dir
+    FLAGS.set("save_dir", "")
+    try:
+        tr.train(lambda: iter(data), num_passes=1, feeder=feeder,
+                 event_handler=lambda e: None)
+    finally:
+        FLAGS.set("save_dir", old_save)
+    assert observe.histogram("data_reader_wait_seconds").count() == 6
+    ratio = observe.gauge("input_bound_ratio").value()
+    assert 0.0 <= ratio <= 1.0
+    # the convert work really ran on worker threads
+    assert observe.histogram(
+        "pipeline_worker_convert_seconds").count() == 6
+    _wait_no_io_threads()
+
+
+def test_trainer_test_job_through_pipeline(prefetch_flags):
+    """`Trainer.test` rides the same pipeline and matches the
+    synchronous path's metrics exactly."""
+    from test_distributed import _tiny_trainer
+
+    rng = np.random.RandomState(1)
+    data = [[(rng.randn(8).astype(np.float32), int(rng.randint(0, 2)))
+             for _ in range(8)] for _ in range(4)]
+    tr, feeder = _tiny_trainer()
+    FLAGS.set("prefetch_depth", 0)
+    sync = tr.test(lambda: iter(data), feeder)
+    FLAGS.set("prefetch_depth", 3)
+    pre = tr.test(lambda: iter(data), feeder)
+    assert sync == pre
+    _wait_no_io_threads()
+
+
+# ------------------------------------------------ reader bug regressions
+def test_xmap_mapper_exception_does_not_hang():
+    """Pre-round-11 bug: a mapper exception killed the worker thread
+    without enqueuing _End, wedging the consumer on out_q.get()
+    forever.  Now it re-raises in the consumer."""
+
+    def boom(x):
+        if x == 7:
+            raise RuntimeError("mapper boom")
+        return x * 2
+
+    for order in (False, True):
+        r = R.xmap_readers(boom, lambda: iter(range(20)), 3, 4,
+                           order=order)
+        with pytest.raises(RuntimeError, match="mapper boom"):
+            list(r())
+    _wait_no_io_threads()
+
+
+def test_xmap_feed_exception_does_not_hang():
+    def bad_reader():
+        yield 1
+        raise ValueError("source boom")
+
+    r = R.xmap_readers(lambda x: x, bad_reader, 2, 4)
+    with pytest.raises(ValueError, match="source boom"):
+        list(r())
+    _wait_no_io_threads()
+
+
+def test_xmap_consumer_abandonment_joins_threads():
+    r = R.xmap_readers(lambda x: x, lambda: iter(range(1000)), 3, 2)
+    g = r()
+    next(g)
+    g.close()
+    _wait_no_io_threads()
+
+
+def test_xmap_still_maps_and_orders():
+    r = R.xmap_readers(lambda x: x * 10, lambda: iter(range(30)), 4, 8,
+                       order=True)
+    assert list(r()) == [i * 10 for i in range(30)]
+    r2 = R.xmap_readers(lambda x: x, lambda: iter(range(30)), 4, 8)
+    assert sorted(r2()) == list(range(30))
+    _wait_no_io_threads()
+
+
+def test_buffered_abandonment_stops_producer():
+    """Pre-round-11 bug: a consumer abandoning buffered() mid-pass left
+    the producer thread blocked on q.put against the full queue
+    forever.  Now teardown stops+joins it and closes the source."""
+    state = {"closed": False}
+
+    def src():
+        try:
+            for i in range(100_000):
+                yield i
+        finally:
+            state["closed"] = True
+
+    g = R.buffered(lambda: src(), 2)()
+    next(g)
+    g.close()
+    _wait_no_io_threads()
+    assert state["closed"] is True
+
+
+def test_eagerly_raising_reader_propagates_not_hangs():
+    """A reader that raises BEFORE returning its iterable (e.g. opens a
+    missing file eagerly) must re-raise in the consumer of buffered()
+    and xmap_readers(), not kill the producer thread silently."""
+
+    def eager(**_):
+        raise IOError("missing file")
+
+    with pytest.raises(IOError, match="missing file"):
+        list(R.buffered(eager, 2)())
+    with pytest.raises(IOError, match="missing file"):
+        list(R.xmap_readers(lambda x: x, eager, 2, 4)())
+    _wait_no_io_threads()
+
+
+def test_prefetch_reader_dropped_unstarted_leaks_nothing():
+    """Invoking prefetch_reader's reader and dropping the iterator
+    before the first next() must not start (and leak) worker threads
+    or hold the source open."""
+    state = {"started": False}
+
+    def src():
+        state["started"] = True
+        yield 1
+
+    it = prefetch_reader(lambda: src(), depth=2, workers=2)()
+    del it
+    _wait_no_io_threads()
+    assert state["started"] is False
+
+
+def test_buffered_still_streams_and_raises():
+    assert list(R.buffered(lambda: iter(range(50)), 4)()) \
+        == list(range(50))
+
+    def bad():
+        yield 1
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError):
+        list(R.buffered(bad, 4)())
+    _wait_no_io_threads()
