@@ -32,6 +32,15 @@ Six workloads (the first printed line is the driver-parsed metric):
    ResNet-50 / transformer rows; headline value is the worst
    prefetch-mode ``input_bound_ratio`` (target < 0.05).  See
    :func:`bench_pipeline`; ``--pipeline_small`` for CPU-scale shapes.
+8. **precision A/B** (round 12) — ``--precision=fp32`` vs ``bf16``
+   (fp32 masters + bf16 compute + dynamic loss scaling) on the LSTM /
+   ResNet-50 / transformer train rows, headline = second-best speedup
+   (target ≥ 1.2 on at least two workloads), MFU targets 0.45 / 0.35;
+   plus an fp32-vs-int8 serving-artifact row (latency, top-1/loss
+   delta).  See :func:`bench_precision`; ``--precision_small`` for
+   CPU-scale shapes.  Every emitted JSON line (all lanes) now carries
+   a ``precision_policy`` stamp with the resolved per-op dispatch
+   dtypes.
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
 Adam).  Timing chains K steps inside one ``lax.scan`` program (see
@@ -90,9 +99,11 @@ def _hbm_gb_per_step(trainer, feed):
 
         trainer.train_one_batch(feed)        # ensure built + compiled
         sfeed = trainer._shard_feed(feed)
-        lowered = trainer._train_step.lower(
-            trainer.params, trainer.opt_state, trainer.buffers, sfeed,
-            jax.random.PRNGKey(0), jnp.zeros((), jnp.float32))
+        args = (trainer.params, trainer.opt_state, trainer.buffers,
+                sfeed, jax.random.PRNGKey(0), jnp.zeros((), jnp.float32))
+        if getattr(trainer, "_ls_state", None) is not None:
+            args += (trainer._ls_state,)     # --precision=bf16 step
+        lowered = trainer._train_step.lower(*args)
         ca = lowered.compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
@@ -144,30 +155,39 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
     sfeed = trainer._shard_feed(feed)
     rng = jax.random.PRNGKey(0)
     progress = jnp.zeros((), jnp.float32)
+    # --precision=bf16 trainers thread the loss-scale state through the
+    # step; carry it in the scan so the timed program is the production
+    # mixed-precision step (finite-check, select, scale update included)
+    mixed = getattr(trainer, "_ls_state", None) is not None
 
     def k_steps(k):
         def body(carry, _):
+            if mixed:
+                p, o, b, s = carry
+                p, o, b, loss, s = raw(p, o, b, sfeed, rng, progress, s)
+                return (p, o, b, s), loss
             p, o, b = carry
             p, o, b, loss = raw(p, o, b, sfeed, rng, progress)
             return (p, o, b), loss
 
-        @_partial(jax.jit, donate_argnums=(0, 1, 2))
-        def run(p, o, b):
-            (p, o, b), losses = lax.scan(body, (p, o, b), None, length=k)
-            return p, o, b, losses[-1]
+        @_partial(jax.jit, donate_argnums=(0,))
+        def run(carry):
+            carry, losses = lax.scan(body, carry, None, length=k)
+            return carry, losses[-1]
         return run
 
     def snapshot():
-        return jax.tree_util.tree_map(
-            lambda x: x.copy(),
-            (trainer.params, trainer.opt_state, trainer.buffers))
+        state = (trainer.params, trainer.opt_state, trainer.buffers)
+        if mixed:
+            state += (trainer._ls_state,)
+        return jax.tree_util.tree_map(lambda x: x.copy(), state)
 
     def samples(run, n=3, drop_first=True):
         times = []
         for _ in range(n):   # first sample pays the compile
-            p, o, b = snapshot()
+            carry = snapshot()
             t0 = time.perf_counter()
-            p, o, b, loss = run(p, o, b)
+            carry, loss = run(carry)
             float(loss)
             times.append((time.perf_counter() - t0) * 1000.0)
         return times[1:] if drop_first else times
@@ -176,10 +196,12 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
         # the already-compiled single-step program shares the dispatch +
         # sync fixed costs with the scan programs; using it as the
         # baseline saves one scan(1) compile per workload
-        return min(samples(
-            lambda p, o, b: trainer._train_step(p, o, b, sfeed, rng,
-                                                progress),
-            drop_first=False))
+        def one(carry):
+            out = trainer._train_step(*carry[:3], sfeed, rng, progress,
+                                      *carry[3:])
+            state = (out[:3] + out[4:]) if mixed else out[:3]
+            return state, out[3]
+        return min(samples(one, drop_first=False))
 
     one = one_step_time()
     run = k_steps(1 + iters)     # compiled once, reused across retries
@@ -745,6 +767,238 @@ def bench_pipeline():
     return _with_band(r)
 
 
+# --precision_small: CPU-runnable shapes for the fp32/bf16 A/B lane
+PRECISION_SMALL = False
+
+
+def _prec_lstm():
+    """LSTM text-classifier precision-A/B workload (bench_lstm's config
+    minus the bf16_activations override — precision is the only knob)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import lstm_text_classifier
+
+    if PRECISION_SMALL:
+        B, T, H, V, E = 16, 32, 128, 2000, 32
+    else:
+        B, T, H, V, E = 128, 100, 512, 30000, 128
+    cfg = lstm_text_classifier(vocab_size=V, embed_dim=E, hidden_size=H,
+                               lstm_num=2, num_classes=2)
+    trainer = _mk_trainer(cfg, l2=8e-4)
+    rng = np.random.RandomState(0)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
+                jax.numpy.asarray(np.full((B,), T, np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (B,)).astype(np.int32))}
+    fwd = 2 * B * T * (E * 4 * H + 3 * H * 4 * H)
+    return trainer, feed, fwd
+
+
+def _prec_resnet():
+    """ResNet-50 precision-A/B workload (cifar ResNet-20 on the small
+    lane — same conv+BN block family at CPU scale)."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector, integer_value
+    from paddle_tpu.models.image import resnet, resnet_cifar10
+
+    if PRECISION_SMALL:
+        B, IMG, NCLASS = 8, 32, 10
+        fwd_per_img = 41e6 * 2        # cifar resnet20 MACs, approximate
+    else:
+        B, IMG, NCLASS = 128, 224, 1000
+        fwd_per_img = 3.858e9 * 2     # exact conv+fc MACs of this config
+    with config_scope():
+        img = dsl.data("image", dense_vector(3 * IMG * IMG),
+                       height=IMG, width=IMG)
+        lab = dsl.data("label", integer_value(NCLASS))
+        if PRECISION_SMALL:
+            probs = resnet_cifar10(img, depth=20, num_classes=NCLASS)
+        else:
+            probs = resnet(img, depth=50, num_classes=NCLASS)
+        cost = dsl.classification_cost(probs, lab)
+        cfg = dsl.topology(cost)
+    trainer = _mk_trainer(cfg, lr=1e-3)
+    rng = np.random.RandomState(0)
+    feed = {"image": jax.numpy.asarray(
+                rng.randn(B, 3 * IMG * IMG).astype(np.float32)),
+            "label": jax.numpy.asarray(
+                rng.randint(0, NCLASS, (B,)).astype(np.int32))}
+    return trainer, feed, fwd_per_img * B
+
+
+def _prec_transformer():
+    """Transformer precision-A/B workload (bench_attention's config)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer_text_classifier
+
+    if PRECISION_SMALL:
+        B, T, D, HEADS, L, F, V = 4, 128, 64, 4, 2, 128, 2000
+    else:
+        B, T, D, HEADS, L, F, V = 16, 2048, 512, 8, 4, 2048, 30000
+    cfg = transformer_text_classifier(
+        vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
+        ffn_dim=F, num_classes=2, max_len=T)
+    trainer = _mk_trainer(cfg, lr=1e-3)
+    rng = np.random.RandomState(0)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
+                jax.numpy.asarray(np.full((B,), T, np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (B,)).astype(np.int32))}
+    fwd = 2 * L * B * T * (3 * D * D + 2 * T * D + D * D + 2 * D * F)
+    return trainer, feed, fwd
+
+
+def _precision_serving_row():
+    """fp32 vs int8-weights-only artifact A/B: per-call latency plus
+    top-1 / loss delta on a FIXED synthetic eval slice (seeded data and
+    labels, identical for both artifacts — the delta isolates
+    quantization, per the Gemma-on-TPU measurement template)."""
+    import tempfile
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.data.feeder import dense_vector, integer_value
+    from paddle_tpu.layers import NeuralNetwork
+    from paddle_tpu.serving import ServedModel, export_network
+
+    DIM, NCLASS, B, CALLS = (64, 10, 32, 10) if PRECISION_SMALL \
+        else (784, 10, 128, 30)
+    with config_scope():
+        img = dsl.data_layer("img", dense_vector(DIM))
+        lbl = dsl.data_layer("label", integer_value(NCLASS))
+        h1 = dsl.fc_layer(img, size=4 * DIM, act=dsl.ReluActivation())
+        h2 = dsl.fc_layer(h1, size=4 * DIM, act=dsl.ReluActivation())
+        pred = dsl.fc_layer(h2, size=NCLASS,
+                            act=dsl.SoftmaxActivation(),
+                            name="prediction")
+        cfg = dsl.topology(dsl.classification_cost(pred, lbl))
+    net = NeuralNetwork(cfg)
+    params = net.init_params(7)
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, DIM).astype(np.float32)
+    labels = rng.randint(0, NCLASS, (B,))
+
+    def artifact_size(d):
+        import os
+        return sum(os.path.getsize(os.path.join(d, f))
+                   for f in os.listdir(d))
+
+    def bench_artifact(d):
+        m = ServedModel.load(d)
+        for _ in range(3):                      # warmup / compile
+            m(img=x)
+        times = []
+        for _ in range(CALLS):
+            t0 = time.perf_counter()
+            probs = m(img=x)["prediction"]
+            times.append((time.perf_counter() - t0) * 1e3)
+        probs = np.asarray(probs, np.float32)
+        ce = float(np.mean(-np.log(
+            np.maximum(probs[np.arange(B), labels], 1e-9))))
+        return (round(float(np.median(times)), 3), probs.argmax(1), ce,
+                artifact_size(d))
+
+    with tempfile.TemporaryDirectory(prefix="ptpu-bench-prec-") as tmp:
+        d32 = tmp + "/fp32"
+        d8 = tmp + "/int8"
+        export_network(net, params, {"img": x}, d32)
+        export_network(net, params, {"img": x}, d8, quantize="int8")
+        ms32, top32, ce32, sz32 = bench_artifact(d32)
+        ms8, top8, ce8, sz8 = bench_artifact(d8)
+    return {
+        "workload": "serving_int8",
+        "fp32": {"ms_per_call": ms32, "loss": round(ce32, 5),
+                 "artifact_bytes": sz32},
+        "int8": {"ms_per_call": ms8, "loss": round(ce8, 5),
+                 "artifact_bytes": sz8},
+        "latency_ratio": round(ms8 / max(ms32, 1e-9), 3),
+        "top1_delta": round(float((top32 != top8).mean()), 4),
+        "loss_delta": round(abs(ce32 - ce8), 5),
+        "size_ratio": round(sz8 / max(sz32, 1), 3),
+        "batch": B,
+    }
+
+
+def bench_precision():
+    """Precision A/B lane (`--only precision`, round 12): each training
+    workload runs the SAME step twice — `--precision=fp32` (full fp32,
+    legacy bf16 knobs forced off) vs `--precision=bf16` (fp32 masters,
+    bf16 compute, dynamic loss scaling) — timed by the in-scan method,
+    so the bf16 number pays the full mixed-precision tax (cast, finite
+    check, scale update).  Headline value is the SECOND-BEST bf16/fp32
+    speedup across the three workloads: value ≥ 1.2 ⟺ the "bf16 ≥ 1.2×
+    on at least two of {LSTM, ResNet-50, transformer}" acceptance bound.
+    MFU targets ride each row (ResNet-50 ≥ 0.45, transformer ≥ 0.35 —
+    ROADMAP item 3).  A serving row A/Bs the fp32 vs int8 weights-only
+    artifact (latency + top-1/loss delta on a fixed eval slice)."""
+    saved = {k: FLAGS.get(k)
+             for k in ("precision", "use_bf16", "bf16_activations")}
+    iters = 16 if PRECISION_SMALL else 64
+    workloads = [("lstm_text_cls", _prec_lstm, None),
+                 ("resnet50" if not PRECISION_SMALL
+                  else "resnet20_cifar", _prec_resnet, 0.45),
+                 ("transformer", _prec_transformer, 0.35)]
+    rows = []
+    try:
+        # the legacy knobs would make the "fp32" lane bf16 on TPU;
+        # force them off so --precision is the only variable
+        FLAGS.set("use_bf16", False)
+        FLAGS.set("bf16_activations", False)
+        for tag, build, mfu_target in workloads:
+            per = {}
+            for prec in ("fp32", "bf16"):
+                FLAGS.set("precision", prec)
+                trainer, feed, fwd_flops = build()
+                ms, agree = _scan_time_ms(trainer, feed, iters=iters)
+                n = _n_chips(trainer)
+                mfu = TRAIN_FLOP_FACTOR * fwd_flops / (ms / 1e3) \
+                    / (PEAK_FLOPS_BF16 * n)
+                per[prec] = {"ms_per_batch": round(ms, 3),
+                             "mfu_est": round(mfu, 3),
+                             "timing_self_check": round(agree, 3)}
+                del trainer
+                jax.clear_caches()
+            speedup = per["fp32"]["ms_per_batch"] \
+                / max(per["bf16"]["ms_per_batch"], 1e-9)
+            row = {"workload": tag, **per,
+                   "speedup": round(speedup, 3),
+                   "speedup_ok": speedup >= 1.2}
+            if mfu_target is not None:
+                row["mfu_target"] = mfu_target
+                row["mfu_ok"] = per["bf16"]["mfu_est"] >= mfu_target
+            rows.append(row)
+        FLAGS.set("precision", "fp32")
+        serving = _precision_serving_row()
+    finally:
+        for k, v in saved.items():
+            FLAGS.set(k, v)
+    speedups = sorted(r["speedup"] for r in rows)
+    return _with_band({
+        "metric": "precision_bf16_speedup_2nd_best",
+        "value": round(speedups[-2], 3),
+        "unit": ("second-best bf16/fp32 step-throughput speedup across "
+                 "{LSTM, ResNet, transformer} (target ≥ 1.2 ⟺ at least "
+                 "two workloads pass; "
+                 f"{'small' if PRECISION_SMALL else 'bench'} scale)"),
+        "target": 1.2,
+        "passed": sum(r["speedup_ok"] for r in rows) >= 2,
+        "scale": "small" if PRECISION_SMALL else "bench",
+        "rows": rows,
+        "serving": serving,
+    })
+
+
+def _precision_stamp():
+    """Active precision policy + resolved per-op dispatch dtypes,
+    stamped on EVERY emitted JSON line (the round-8 `path`-field
+    pattern): artifacts are self-describing across fp32/bf16 A/Bs."""
+    from paddle_tpu.core.dtypes import dispatch_dtypes
+
+    return dispatch_dtypes()
+
+
 def _workload_metrics(before):
     """Per-workload telemetry merged onto the emitted JSON line: counter
     DELTAS across the workload (dispatch-tier decisions, recompiles,
@@ -773,9 +1027,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     choices=["lstm", "resnet", "seq2seq", "attention",
-                             "lstm1280", "lstm2048", "pipeline"])
+                             "lstm1280", "lstm2048", "pipeline",
+                             "precision"])
     ap.add_argument("--pipeline_small", action="store_true",
                     help="run the input-pipeline A/B lane at CPU-"
+                         "runnable shapes (the JSON line records "
+                         "scale='small'); default is bench scale")
+    ap.add_argument("--precision_small", action="store_true",
+                    help="run the fp32/bf16 precision A/B lane at CPU-"
                          "runnable shapes (the JSON line records "
                          "scale='small'); default is bench scale")
     ap.add_argument("--profile", action="store_true",
@@ -800,17 +1059,22 @@ def main():
     if args.pipeline_small:
         global PIPELINE_SMALL
         PIPELINE_SMALL = True
+    if args.precision_small:
+        global PRECISION_SMALL
+        PRECISION_SMALL = True
     benches = {"lstm": bench_lstm, "resnet": bench_resnet,
                "seq2seq": bench_seq2seq, "attention": bench_attention,
                "lstm1280": bench_lstm_1280, "lstm2048": bench_lstm_2048,
-               "pipeline": bench_pipeline}
+               "pipeline": bench_pipeline, "precision": bench_precision}
     order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
                                            "attention", "lstm1280",
-                                           "lstm2048", "pipeline"]
+                                           "lstm2048", "pipeline",
+                                           "precision"]
     for name in order:
         try:
             before = observe.REGISTRY.flat(kinds=("counter",))
             r = benches[name]()
+            r["precision_policy"] = _precision_stamp()
             r["metrics"] = _workload_metrics(before)
             print(json.dumps(r), flush=True)
         except Exception as e:          # noqa: BLE001 — report, don't die

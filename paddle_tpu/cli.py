@@ -226,6 +226,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(jax.distributed)")
     tp.add_argument("--mesh_shape", default="",
                     help="e.g. data=4,model=2 (replaces --trainer_count)")
+    tp.add_argument("--precision", default=None,
+                    choices=["fp32", "bf16"],
+                    help="training precision policy: bf16 = fp32 "
+                         "master weights + bf16 compute + dynamic "
+                         "loss scaling (default fp32)")
     tp.add_argument("--use_bf16", type=int, default=None)
     tp.add_argument("--bf16_activations", type=int, default=None)
     tp.add_argument("--log_level", default="",
@@ -290,6 +295,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     if getattr(args, "mesh_shape", ""):
         FLAGS.set("mesh_shape", args.mesh_shape)
+    if getattr(args, "precision", None) is not None:
+        FLAGS.set("precision", args.precision)
     if getattr(args, "use_bf16", None) is not None:
         FLAGS.set("use_bf16", bool(args.use_bf16))
     if getattr(args, "bf16_activations", None) is not None:

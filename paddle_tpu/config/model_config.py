@@ -228,6 +228,12 @@ class OptimizationConfig:
     max_average_window: int = 0
     num_batches_per_send_parameter: int = 1
     num_batches_per_get_parameter: int = 1
+    # Training precision policy: "fp32" | "bf16" | "" (empty = inherit
+    # the --precision flag, whose default is fp32).  bf16 = fp32 master
+    # weights with bf16 compute casts at the train-step boundary, fp32
+    # optimizer state/gradient accumulation, and dynamic loss scaling —
+    # see core/dtypes.resolve_precision and trainer/trainer.py.
+    precision: str = ""
     # Async-SGD re-expression (ParameterServer2.h:468 lock-free async
     # apply; doOperation AVERAGE_PARAMETER, ParameterService.proto:24-110):
     # each data-parallel shard applies K local optimizer steps without

@@ -11,11 +11,45 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import Iterator
+from typing import Dict, Iterator, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils import FLAGS
+
+# ---------------------------------------------------------------------
+# Canonical dtype-name <-> numpy mapping (the DataType proto equivalent).
+#
+# bfloat16 is the one name plain numpy cannot parse (``np.dtype("bfloat16")``
+# raises — the type lives in ml_dtypes, re-exported as ``jnp.bfloat16``),
+# so every boundary that round-trips dtypes BY NAME — DataFeeder feeds,
+# serving manifests (``serving/export._feed_spec`` / ``loader``),
+# checkpoint var metadata — resolves through this table instead of
+# ``np.dtype(name)`` directly.
+_NP_DTYPES: Dict[str, np.dtype] = {
+    name: np.dtype(t) for name, t in {
+        "float32": np.float32, "float64": np.float64,
+        "float16": np.float16, "bfloat16": jnp.bfloat16,
+        "int8": np.int8, "int16": np.int16,
+        "int32": np.int32, "int64": np.int64,
+        "uint8": np.uint8, "bool": np.bool_,
+    }.items()
+}
+
+
+def np_dtype(name) -> np.dtype:
+    """Dtype name (or dtype-like) → numpy dtype, bfloat16 included."""
+    if isinstance(name, str) and name in _NP_DTYPES:
+        return _NP_DTYPES[name]
+    return np.dtype(name)
+
+
+def dtype_name(dt) -> str:
+    """Canonical string name of a (numpy/jax) dtype — the inverse of
+    :func:`np_dtype`; ``str(np.dtype)`` already yields "bfloat16" for
+    the ml_dtypes extension type."""
+    return str(np.dtype(dt))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,9 +84,35 @@ _bf16_act = Policy(jnp.float32, jnp.bfloat16, jnp.bfloat16)
 _override: list = []
 
 
+def resolve_precision(opt_config=None) -> str:
+    """The active end-to-end precision policy name: "fp32" | "bf16".
+
+    An explicit ``OptimizationConfig.precision`` wins; empty inherits
+    the ``--precision`` flag (default fp32).  This is the ONE resolution
+    point the trainer, the op-level policy, and the bench stamp share.
+    """
+    prec = getattr(opt_config, "precision", "") or FLAGS.precision
+    if prec not in ("fp32", "bf16"):
+        raise ValueError(
+            f"precision must be 'fp32' or 'bf16', got {prec!r}")
+    return prec
+
+
+def policy_for(precision: str) -> Policy:
+    """Op-dispatch policy of a named precision: bf16 = bf16 compute
+    with fp32 accumulation/outputs (bf16 activation storage only when
+    --bf16_activations additionally opts in); fp32 = full fp32."""
+    if precision == "bf16":
+        return _bf16_act if FLAGS.bf16_activations else _bf16
+    return _f32
+
+
 def current_policy() -> Policy:
     if _override:
         return _override[-1]
+    if FLAGS.precision == "bf16":
+        # the one-flag mixed-precision policy overrides the legacy knobs
+        return policy_for("bf16")
     if not FLAGS.use_bf16:
         return _f32
     return _bf16_act if FLAGS.bf16_activations else _bf16
@@ -72,3 +132,40 @@ def full_precision() -> Iterator[None]:
     """fp32 everywhere — used by the gradient checker."""
     with policy_scope(_f32):
         yield
+
+
+def record_op_precision(op: str) -> None:
+    """Tick ``precision_dispatch_total{op,dtype}``: which compute dtype
+    an op family actually dispatched with.  Ops run at TRACE time under
+    jit, so this counts once per compiled program per shape — the same
+    convention as ``rnn_dispatch_total``/``conv_dispatch_total`` — and
+    the artifact/test answer to "did the bf16 policy actually reach
+    this kernel" no longer rests on reading the lowering."""
+    from ..observe import counter  # lazy: keeps core import-light
+
+    counter(
+        "precision_dispatch_total",
+        "op dispatches by resolved compute dtype (trace-time: one tick "
+        "per compiled program per shape, labels op + policy compute "
+        "dtype)",
+    ).inc(op=op, dtype=dtype_name(current_policy().compute_dtype))
+
+
+def dispatch_dtypes(opt_config=None) -> Dict[str, str]:
+    """Resolved per-op-tier dtypes of the active policy — the
+    self-describing precision stamp bench.py attaches to every JSON
+    line (the round-8 ``path``-field pattern, applied to dtype)."""
+    prec = resolve_precision(opt_config)
+    pol = policy_for(prec) if prec == "bf16" else current_policy()
+    cd, od = dtype_name(pol.compute_dtype), dtype_name(pol.output_dtype)
+    return {
+        "policy": prec,
+        "matmul": cd, "conv": cd, "rnn_gates": cd, "attention": cd,
+        # accumulator/carry tiers are pinned fp32 by construction:
+        # BN stats (ops/nn_ops._bn_stats), Pallas RNN VMEM gate math,
+        # flash-attention accumulators — regardless of compute dtype
+        "bn_stats": "float32", "fused_rnn_state": "float32",
+        "attention_accum": "float32",
+        "scan_carry": od, "activations": od,
+        "master_params": "float32", "optimizer_state": "float32",
+    }

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dtypes import np_dtype
 from ..core.sequence import SequenceBatch, pad_batch, pad_nested_batch
 from ..utils import ConfigError, enforce
 
@@ -29,14 +30,19 @@ class InputType:
     dim: int
     seq_level: int = 0  # 0: none, 1: sequence, 2: sub-sequence
     kind: str = "dense"  # dense | sparse_binary | sparse_float | index
+    # storage dtype of dense feeds ("float32" default; "bfloat16" halves
+    # feed H2D traffic under the bf16 policy — resolved through
+    # core.dtypes.np_dtype, which plain numpy name parsing can't do).
+    # Index kinds always feed int32.
+    dtype: str = "float32"
 
 
-def dense_vector(dim: int) -> InputType:
-    return InputType(dim, 0, "dense")
+def dense_vector(dim: int, dtype: str = "float32") -> InputType:
+    return InputType(dim, 0, "dense", dtype)
 
 
-def dense_vector_sequence(dim: int) -> InputType:
-    return InputType(dim, 1, "dense")
+def dense_vector_sequence(dim: int, dtype: str = "float32") -> InputType:
+    return InputType(dim, 1, "dense", dtype)
 
 
 def sparse_binary_vector(dim: int) -> InputType:
@@ -78,17 +84,21 @@ class DataFeeder:
         self.feeding = [(name, t) for name, t in feeding]
         self.buckets = buckets
 
-    def _densify(self, row, dim: int, kind: str) -> np.ndarray:
+    def _densify(self, row, dim: int, kind: str,
+                 dtype: str = "float32") -> np.ndarray:
+        dt = np_dtype(dtype)
         if kind == "sparse_binary":
-            out = np.zeros(dim, np.float32)
+            out = np.zeros(dim, dt)
             out[np.asarray(row, np.int64)] = 1.0
             return out
         if kind == "sparse_float":
             ids, vals = zip(*row) if row else ((), ())
-            out = np.zeros(dim, np.float32)
+            out = np.zeros(dim, dt)
             out[np.asarray(ids, np.int64)] = np.asarray(vals, np.float32)
             return out
-        return np.asarray(row, np.float32)
+        # copy=False keeps the pre-round-12 zero-copy fast path for
+        # rows already stored at the target dtype (hot host feed path)
+        return np.asarray(row).astype(dt, copy=False)
 
     @staticmethod
     def _materialize(row):
@@ -119,11 +129,13 @@ class DataFeeder:
                                      if isinstance(sample, dict)
                                      else sample[slot])
                    for sample in batch]
+            dt = getattr(itype, "dtype", "float32")
             if itype.seq_level == 0:
                 if itype.kind == "index":
                     feed[name] = jnp.asarray(np.asarray(col, np.int32))
                 else:
-                    rows = [self._densify(r, itype.dim, itype.kind) for r in col]
+                    rows = [self._densify(r, itype.dim, itype.kind, dt)
+                            for r in col]
                     feed[name] = jnp.asarray(np.stack(rows))
             elif itype.seq_level == 1:
                 if itype.kind == "index":
@@ -131,16 +143,19 @@ class DataFeeder:
                     feed[name] = pad_batch(seqs, buckets=self.buckets,
                                            dtype=np.int32)
                 else:
-                    seqs = [np.stack([self._densify(x, itype.dim, itype.kind)
+                    seqs = [np.stack([self._densify(x, itype.dim,
+                                                    itype.kind, dt)
                                       for x in r]) if len(r) else
-                            np.zeros((0, itype.dim), np.float32) for r in col]
+                            np.zeros((0, itype.dim), np_dtype(dt))
+                            for r in col]
                     feed[name] = pad_batch(seqs, buckets=self.buckets)
             else:  # sub-sequence
                 if itype.kind == "index":
                     nested = [[np.asarray(s, np.int32) for s in r] for r in col]
                     feed[name] = pad_nested_batch(nested, dtype=np.int32)
                 else:
-                    nested = [[np.stack([self._densify(x, itype.dim, itype.kind)
+                    nested = [[np.stack([self._densify(x, itype.dim,
+                                                       itype.kind, dt)
                                          for x in s]) for s in r] for r in col]
                     feed[name] = pad_nested_batch(nested)
         return feed

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.model_config import ParameterConfig
+from ..core.dtypes import current_policy, record_op_precision
 from ..core.sequence import SequenceBatch, like, value_of
 from ..ops.pallas_attention import flash_attention
 from ..utils import enforce
@@ -79,19 +80,27 @@ class MultiHeadAttentionLayer(Layer):
         size = self.conf.size
         heads = self.conf.attrs.get("num_heads", 1)
         dh = size // heads
+        # policy compute dtype for the projections AND the kernel's
+        # q/k/v: without the explicit cast a bf16 activation against an
+        # fp32 weight silently PROMOTES the matmul to fp32 (jnp
+        # promotion), so the fused tier never saw bf16 inputs.  The
+        # flash kernel accumulates in f32 internally regardless.
+        pol = current_policy()
+        record_op_precision("attention")
+        cd = pol.compute_dtype
         if len(inputs) == 1:
             x, q_len = _seq_parts(inputs[0])
-            qkv = x @ params[self.weight_name(0)]        # [B, T, 3·size]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            qkv = x.astype(cd) @ params[self.weight_name(0)].astype(cd)
+            q, k, v = jnp.split(qkv, 3, axis=-1)   # [B, T, 3·size]
             kv_len = q_len
         else:
             xq, q_len = _seq_parts(inputs[0])
             xk, kv_len = _seq_parts(inputs[1])
             xv, v_len = _seq_parts(inputs[2])
             del v_len  # value lengths follow the key sequence
-            q = xq @ params[self.weight_name(0)]
-            k = xk @ params[self.weight_name(1)]
-            v = xv @ params[self.weight_name(2)]
+            q = xq.astype(cd) @ params[self.weight_name(0)].astype(cd)
+            k = xk.astype(cd) @ params[self.weight_name(1)].astype(cd)
+            v = xv.astype(cd) @ params[self.weight_name(2)].astype(cd)
 
         b, tq = q.shape[0], q.shape[1]
         tk = k.shape[1]
@@ -101,7 +110,9 @@ class MultiHeadAttentionLayer(Layer):
             bool(self.conf.attrs.get("causal", False)),
             int(self.conf.attrs.get("block_q", 512)),
             int(self.conf.attrs.get("block_k", 512)))
-        out = out.reshape(b, tq, size) @ params[f"_{self.name}.wo"]
+        out = out.reshape(b, tq, size) \
+            @ params[f"_{self.name}.wo"].astype(cd)
+        out = out.astype(pol.output_dtype)
         if self.conf.with_bias:
             out = out + params[self.bias_name()].astype(out.dtype)
         out = like(inputs[0], out) if isinstance(inputs[0], SequenceBatch) \

@@ -246,6 +246,22 @@ class NeuralNetwork:
         pairs.set(len(self._bn_conv_fuse) - fwd3,
                   direction="fwd", kernel="1x1")
 
+        # build-time precision census: which compute/output dtypes the
+        # op policy resolved to when each network was built (the
+        # trainer may still override per-step via policy_scope — this
+        # records the flag-resolved default the bench stamp also
+        # reads).  A monotonic per-policy counter, like the fused-pair
+        # census above: a process that builds under two policies (the
+        # bench precision A/B) keeps both series honest.
+        from ..core.dtypes import current_policy, dtype_name
+        from ..observe import counter
+        pol = current_policy()
+        counter("network_builds_total",
+                "networks built, labeled by the op-policy dtypes "
+                "resolved at build time").inc(
+            compute=dtype_name(pol.compute_dtype),
+            output=dtype_name(pol.output_dtype))
+
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:
             for spec in layer.param_specs():

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.dtypes import current_policy
+from ..core.dtypes import current_policy, record_op_precision
 from .registry import register_op
 
 
@@ -31,6 +31,7 @@ def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
     """
     pol = current_policy()
     if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+        record_op_precision("matmul")
         x = x.astype(pol.compute_dtype)
         y = y.astype(pol.compute_dtype)
     if transpose_x:

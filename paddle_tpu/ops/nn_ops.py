@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..core.dtypes import current_policy
+from ..core.dtypes import current_policy, record_op_precision
 from ..observe import counter
 from .registry import register_op
 
@@ -78,6 +78,7 @@ def conv2d(x, w, stride: IntOr2 = 1, padding="SAME", dilation: IntOr2 = 1,
     lowers this directly to MXU convolutions.
     """
     pol = current_policy()
+    record_op_precision("conv2d")
     x = x.astype(pol.compute_dtype)
     w = w.astype(pol.compute_dtype)
     if isinstance(padding, int):
@@ -506,6 +507,7 @@ def affine_act_conv2d(z, a, c, w, conv_bias=None, act: str = "relu",
     from . import pallas_conv
 
     pol = current_policy()
+    record_op_precision("affine_act_conv2d")
     relu = act == "relu"
     zs, ws = jnp.shape(z), jnp.shape(w)
     fusable_act = act in ("relu", "", "linear")
@@ -591,6 +593,7 @@ def conv2d_bn(x, w, conv_bias, scale, bias, running_mean, running_var,
     from . import pallas_conv
 
     pol = current_policy()
+    record_op_precision("conv2d_bn")
     if in_affine is not None:
         a1, c1, act1 = in_affine
         xs, ws = jnp.shape(x), jnp.shape(w)

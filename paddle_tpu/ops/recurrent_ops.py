@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.dtypes import current_policy
+from ..core.dtypes import current_policy, record_op_precision
 from ..core.sequence import SequenceBatch
 from ..observe import counter
 from ..utils.logger import get_logger, warn_once
@@ -152,6 +152,7 @@ def lstm_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None,
     b, t, _ = seq.data.shape
     h_dim = w_hh.shape[0]
     pol = current_policy()
+    record_op_precision("lstm")
     cd = pol.compute_dtype
     if w_ih is None:  # input already projected to 4H (lstmemory convention)
         xw = seq.data.astype(cd)
@@ -250,6 +251,7 @@ def gru_sequence(seq: SequenceBatch, w_ih, w_hh, bias=None, h0=None,
     b, t, _ = seq.data.shape
     h_dim = w_hh.shape[0]
     pol = current_policy()
+    record_op_precision("gru")
     cd = pol.compute_dtype
     if w_ih is None:  # input already projected to 3H (grumemory convention)
         xw = seq.data.astype(cd)
@@ -328,6 +330,7 @@ def simple_rnn(seq: SequenceBatch, w_hh, bias=None, h0=None,
     projected; h_t = act(x_t + h_{t-1} W + b)."""
     b, t, h_dim = seq.data.shape
     pol = current_policy()
+    record_op_precision("recurrent")
     cd = pol.compute_dtype
     x = seq.data.astype(cd)
     if bias is not None:

@@ -1,0 +1,105 @@
+"""Dynamic loss scaling for the ``--precision=bf16`` training policy.
+
+The classic mixed-precision recipe (Micikevicius et al., "Mixed
+Precision Training"): multiply the loss by a scale before the backward
+pass, divide the gradients by it in fp32 afterwards, and adapt the scale
+from observed overflows — grow 2× after every ``growth_interval``
+overflow-free steps, halve (floor 1.0) and SKIP the update when any
+gradient is non-finite, leaving parameters and optimizer state
+bit-identical.
+
+bf16 shares fp32's 8-bit exponent, so unlike fp16 it cannot underflow a
+gradient the scale would have saved — here the machinery is primarily
+the *skipped-step safety net* (a single inf/nan batch never poisons the
+master weights) and the observability hook (``loss_scale`` gauge,
+``loss_scale_skipped_steps_total``).  The math is kept as pure jittable
+functions over a small state tuple so the trainer threads it through the
+compiled train step and unit tests hit it directly.
+
+State layout (a NamedTuple of device scalars):
+    scale          f32 — current multiplier
+    growth_count   i32 — overflow-free steps since the last change
+    skipped_total  i32 — lifetime skipped steps (device-side so the hot
+                         loop never syncs; the trainer drains the delta
+                         into the observe counter at pass boundaries)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import FLAGS
+
+GROWTH_FACTOR = 2.0
+BACKOFF_FACTOR = 0.5
+MIN_SCALE = 1.0
+# Growth ceiling: without it a long clean run doubles the scale until
+# the f32 scale itself overflows to inf, after which every step skips
+# and backoff (inf*0.5 = inf) can never recover — a silent permanent
+# stall.  2^24 leaves ample headroom over any useful scale.
+MAX_SCALE = float(2 ** 24)
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array
+    growth_count: jax.Array
+    skipped_total: jax.Array
+
+
+def init_state(init_scale: float = None) -> LossScaleState:
+    """Fresh state from ``--loss_scale_init`` (or an explicit value —
+    checkpoint resume passes the persisted scale back in)."""
+    if init_scale is None:
+        init_scale = FLAGS.loss_scale_init
+    return LossScaleState(
+        scale=jnp.asarray(float(init_scale), jnp.float32),
+        growth_count=jnp.zeros((), jnp.int32),
+        skipped_total=jnp.zeros((), jnp.int32))
+
+
+def all_finite(grads: Any) -> jax.Array:
+    """Scalar bool: every float leaf of the gradient pytree is finite."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads)
+              if jnp.issubdtype(jnp.result_type(g), jnp.floating)]
+    finite = jnp.asarray(True)
+    for g in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def unscale(grads: Any, scale: jax.Array) -> Any:
+    """Gradients / scale, accumulated in fp32 (master-grad dtype)."""
+    inv = (1.0 / scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) * inv
+        if jnp.issubdtype(jnp.result_type(g), jnp.floating) else g,
+        grads)
+
+
+def update(state: LossScaleState, finite: jax.Array,
+           growth_interval: int = None) -> LossScaleState:
+    """Post-step scale adaptation (branchless, jit-safe)."""
+    if growth_interval is None:
+        growth_interval = FLAGS.loss_scale_growth_interval
+    count = state.growth_count + 1
+    grow = count >= jnp.asarray(int(growth_interval), jnp.int32)
+    grown_scale = jnp.where(grow, jnp.minimum(state.scale * GROWTH_FACTOR,
+                                              MAX_SCALE),
+                            state.scale)
+    backed_off = jnp.maximum(state.scale * BACKOFF_FACTOR, MIN_SCALE)
+    return LossScaleState(
+        scale=jnp.where(finite, grown_scale, backed_off),
+        growth_count=jnp.where(finite, jnp.where(grow, 0, count), 0)
+        .astype(jnp.int32),
+        skipped_total=state.skipped_total
+        + (1 - finite.astype(jnp.int32)))
+
+
+def select(finite: jax.Array, updated: Any, previous: Any) -> Any:
+    """``updated`` when the step was finite, else ``previous`` —
+    elementwise select keeps the skipped step's state bit-identical."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), updated, previous)

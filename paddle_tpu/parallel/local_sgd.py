@@ -74,6 +74,19 @@ class LocalSGDTrainer(Trainer):
     def __init__(self, network, optimizer=None, opt_config=None, **kwargs):
         super().__init__(network, optimizer=optimizer,
                          opt_config=opt_config, **kwargs)
+        if self.precision == "bf16":
+            # the local-SGD step is its own vmapped program: the
+            # per-shard loss runs under the bf16 policy scope (see
+            # _build_train_step), but the master-cast/loss-scaling
+            # machinery only wraps the base Trainer step
+            from ..utils.logger import warn_once
+            warn_once(
+                "local_sgd_bf16",
+                "precision=bf16 with local_sgd_steps: bf16 compute "
+                "applies, but dynamic loss scaling / skipped-step "
+                "semantics are not wired into the local-SGD step",
+                logger=log)
+            self._ls_state = None
         self.local_steps = max(
             1, getattr(opt_config, "local_sgd_steps", 1) or 1)
         self.n_shards = self.mesh.shape.get(DATA_AXIS, 1)
@@ -104,14 +117,26 @@ class LocalSGDTrainer(Trainer):
         lr_scales = self._lr_scales
         d = self.n_shards
 
-        def one_shard(params, slots, buffers, feed, rng, count, progress):
-            def loss_fn(p):
-                loss, (values, new_buffers) = net.loss(
-                    p, feed, buffers, is_training=True, rng=rng)
-                return loss, new_buffers
+        # config-carried bf16 (OptimizationConfig.precision with the
+        # flag still fp32): enter the policy scope inside the traced
+        # shard step so ops actually dispatch bf16 — the same contract
+        # the base Trainer's mixed step keeps
+        import contextlib
 
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+        from ..core.dtypes import policy_for, policy_scope
+        pol = policy_for("bf16") if self.precision == "bf16" else None
+
+        def one_shard(params, slots, buffers, feed, rng, count, progress):
+            scope = policy_scope(pol) if pol is not None \
+                else contextlib.nullcontext()
+            with scope:
+                def loss_fn(p):
+                    loss, (values, new_buffers) = net.loss(
+                        p, feed, buffers, is_training=True, rng=rng)
+                    return loss, new_buffers
+
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             lr = self.schedule(progress)
             new_params, (_, new_slots) = opt.apply(
                 params, grads, (count, slots), lr, lr_scales)

@@ -14,6 +14,26 @@ whole deployable model, the same way ``paddle_merge_model`` fuses config
 + parameters into one self-contained file for the C inference API
 (``paddle/trainer/MergeModel.cpp``, ``paddle/capi/gradient_machine.h:36``).
 
+**Version 2 — int8 weights-only post-training quantization**
+(``quantize="int8"``): instead of baking fp32 constants, every ≥2-D
+float parameter is stored as int8 with per-output-channel symmetric
+scales (last axis; ``scale_c = max|w[..., c]| / 127``, no zero point) in
+``weights.npz``, and the module takes the weights as runtime ARGUMENTS.
+The loader dequantizes to ``dequant_dtype`` (bf16 by default — the TPU
+serving compute dtype) once at load and prepends them on every call;
+1-D tensors (biases, BN stats) ship raw fp32.  The manifest gains:
+
+    "version": 2,
+    "weights": {"file": "weights.npz",
+                "scheme": "int8-weights-per-channel",
+                "dequant_dtype": "bfloat16",
+                "entries": [{name, shape, dtype, quantized, axis}...]}
+
+Version-1 artifacts keep loading unchanged (``serving/loader.py``
+supports both).  The measurement template is the Gemma-on-TPU study
+(PAPERS.md, arxiv 2605.25645): ~4× smaller weight payload, with the
+latency/accuracy delta reported by ``bench.py --only precision``.
+
 Reference parity: replaces ``paddle_gradient_machine_create_for_inference
 _with_parameters`` + ``_forward``; multi-threaded serving needs no
 ``_create_shared_param`` equivalent — the loaded module is a pure
@@ -24,7 +44,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 # explicit submodule import: pre-0.5 jax does not expose jax.export as
@@ -32,53 +52,82 @@ import jax
 import jax.export
 import numpy as np
 
+from ..core.dtypes import dtype_name, np_dtype
 from ..utils import enforce, get_logger
 
 log = get_logger("serving")
 
 FORMAT_NAME = "paddle-tpu-serving"
 FORMAT_VERSION = 1
+QUANT_FORMAT_VERSION = 2
 MODULE_FILE = "model.stablehlo"
+WEIGHTS_FILE = "weights.npz"
+QUANT_SCHEME = "int8-weights-per-channel"
 
 
 def _feed_spec(name: str, arr: np.ndarray, poly_batch: bool) -> Dict[str, Any]:
     return {"name": name,
             "shape": [None if (poly_batch and i == 0) else int(d)
                       for i, d in enumerate(np.shape(arr))],
-            "dtype": str(np.asarray(arr).dtype)}
+            # dtype_name handles bfloat16 feeds (str() of the ml_dtypes
+            # extension type round-trips through core.dtypes.np_dtype)
+            "dtype": dtype_name(np.asarray(arr).dtype)}
 
 
-def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
-                        fetch_names: Sequence[str],
-                        batch_polymorphic: bool = True) -> str:
-    """Export ``fn(feed_dict) -> dict[name, array]`` to ``dirname``.
+# ------------------------------------------------------------ int8 PTQ
+def quantize_int8(arr: np.ndarray, axis: int = -1
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-channel int8 quantization along ``axis`` (the
+    output-channel axis: HWIO convs and [in, out] fc weights both keep
+    it last).  Returns ``(q int8, scale f32[channels])`` with
+    ``q = clip(round(w / scale), -127, 127)`` — max dequant error is
+    ``scale/2`` per channel."""
+    a = np.asarray(arr, np.float32)
+    ax = axis % a.ndim
+    red = tuple(i for i in range(a.ndim) if i != ax)
+    amax = np.max(np.abs(a), axis=red) if red else np.abs(a)
+    scale = (np.maximum(amax, 1e-12) / 127.0).astype(np.float32)
+    shape = [1] * a.ndim
+    shape[ax] = -1
+    q = np.clip(np.round(a / scale.reshape(shape)), -127, 127) \
+        .astype(np.int8)
+    return q, scale
 
-    ``fn`` must be traceable (weights closed over; they are baked into
-    the module).  With ``batch_polymorphic`` the leading axis of every
-    feed is exported symbolically so one artifact serves any batch size.
-    """
-    feed_names = sorted(example_feed)
-    examples = {k: np.asarray(example_feed[k]) for k in feed_names}
 
-    def flat_fn(*args):
-        out = fn(dict(zip(feed_names, args)))
-        return [out[n] for n in fetch_names]
+def dequantize_int8(q: np.ndarray, scale: np.ndarray, axis: int = -1,
+                    dtype="float32") -> np.ndarray:
+    """Inverse of :func:`quantize_int8` (the loader's load-time path)."""
+    shape = [1] * q.ndim
+    shape[axis % q.ndim] = -1
+    return (q.astype(np.float32) * scale.reshape(shape)) \
+        .astype(np_dtype(dtype))
 
-    def specs(poly: bool):
-        if not poly:
-            return [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                    for a in (examples[k] for k in feed_names)]
-        scope = jax.export.SymbolicScope()
-        b = jax.export.symbolic_shape("b", scope=scope)[0]
-        out = []
-        for k in feed_names:
-            a = examples[k]
-            shape = ((b,) + a.shape[1:]) if a.ndim >= 1 else a.shape
-            out.append(jax.ShapeDtypeStruct(shape, a.dtype))
-        return out
 
-    # one artifact serves every runtime: lower for cpu AND tpu
-    # (jax.export multi-platform lowering)
+def _quantizable(arr: np.ndarray) -> bool:
+    """Weights-only: ≥2-D float tensors (matmul/conv weights).  1-D
+    tensors — biases, BN scale/offset/running stats — ship raw fp32;
+    they are tiny and precision-critical."""
+    return arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating)
+
+
+def _feed_arg_specs(examples: Dict[str, np.ndarray],
+                    feed_names: Sequence[str], poly: bool):
+    if not poly:
+        return [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in (examples[k] for k in feed_names)]
+    scope = jax.export.SymbolicScope()
+    b = jax.export.symbolic_shape("b", scope=scope)[0]
+    out = []
+    for k in feed_names:
+        a = examples[k]
+        shape = ((b,) + a.shape[1:]) if a.ndim >= 1 else a.shape
+        out.append(jax.ShapeDtypeStruct(shape, a.dtype))
+    return out
+
+
+def _serialize_export(flat_fn, specs, examples, batch_polymorphic: bool):
+    """jax.export with the batch-polymorphic-then-fixed fallback; one
+    artifact serves every runtime (multi-platform cpu+tpu lowering)."""
     platforms = ("cpu", "tpu")
 
     def do_export(poly: bool):
@@ -98,6 +147,30 @@ def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
             poly = False
     if exported is None:
         exported = do_export(False)
+    return exported, poly
+
+
+def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
+                        fetch_names: Sequence[str],
+                        batch_polymorphic: bool = True) -> str:
+    """Export ``fn(feed_dict) -> dict[name, array]`` to ``dirname``.
+
+    ``fn`` must be traceable (weights closed over; they are baked into
+    the module).  With ``batch_polymorphic`` the leading axis of every
+    feed is exported symbolically so one artifact serves any batch size.
+    """
+    feed_names = sorted(example_feed)
+    examples = {k: np.asarray(example_feed[k]) for k in feed_names}
+
+    def flat_fn(*args):
+        out = fn(dict(zip(feed_names, args)))
+        return [out[n] for n in fetch_names]
+
+    def specs(poly: bool):
+        return _feed_arg_specs(examples, feed_names, poly)
+
+    exported, poly = _serialize_export(flat_fn, specs, examples,
+                                       batch_polymorphic)
 
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, MODULE_FILE), "wb") as f:
@@ -115,22 +188,7 @@ def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
     return dirname
 
 
-def export_network(network, params: Dict[str, jax.Array],
-                   example_feed: Dict[str, Any], dirname: str,
-                   output_names: Optional[Sequence[str]] = None,
-                   buffers: Optional[Dict[str, jax.Array]] = None,
-                   batch_polymorphic: bool = True) -> str:
-    """Export a layer-engine :class:`NeuralNetwork` for inference.
-
-    ``output_names`` defaults to the network's declared outputs (cost
-    layers replaced by their prediction input, as ``v2.infer`` does).
-
-    :class:`SequenceBatch` feeds are flattened into TWO artifact feeds —
-    ``<name>`` (padded data) and ``<name>_len`` (int32 lengths) — so the
-    standalone loader's plain-array contract covers sequence models.
-    """
-    from ..core.sequence import SequenceBatch, value_of
-
+def _resolve_output_names(network, output_names):
     if output_names is None:
         output_names = []
         for n in network.output_names:
@@ -141,7 +199,13 @@ def export_network(network, params: Dict[str, jax.Array],
             else:
                 output_names.append(n)
     enforce(output_names, "export_network: no output names")
-    bufs = buffers if buffers is not None else network.init_buffers()
+    return list(output_names)
+
+
+def _flatten_example_feed(example_feed: Dict[str, Any]):
+    """SequenceBatch feeds → two plain-array feeds (``<name>`` +
+    ``<name>_len``); returns (flat examples, seq feed names)."""
+    from ..core.sequence import SequenceBatch
 
     seq_feeds = {k for k, v in example_feed.items()
                  if isinstance(v, SequenceBatch)}
@@ -156,13 +220,129 @@ def export_network(network, params: Dict[str, jax.Array],
             flat_examples[k + "_len"] = np.asarray(v.length)
         else:
             flat_examples[k] = v
+    return flat_examples, seq_feeds
 
-    def fn(feed):
+
+def export_network(network, params: Dict[str, jax.Array],
+                   example_feed: Dict[str, Any], dirname: str,
+                   output_names: Optional[Sequence[str]] = None,
+                   buffers: Optional[Dict[str, jax.Array]] = None,
+                   batch_polymorphic: bool = True,
+                   quantize: Optional[str] = None,
+                   dequant_dtype: str = "bfloat16") -> str:
+    """Export a layer-engine :class:`NeuralNetwork` for inference.
+
+    ``output_names`` defaults to the network's declared outputs (cost
+    layers replaced by their prediction input, as ``v2.infer`` does).
+
+    :class:`SequenceBatch` feeds are flattened into TWO artifact feeds —
+    ``<name>`` (padded data) and ``<name>_len`` (int32 lengths) — so the
+    standalone loader's plain-array contract covers sequence models.
+
+    ``quantize="int8"`` writes a **version-2 weights-only quantized**
+    artifact (see the module docstring): per-channel symmetric int8
+    weights in ``weights.npz``, dequantized to ``dequant_dtype`` at
+    load and fed to the module as runtime arguments.  Default (None)
+    keeps the version-1 weights-baked artifact byte-for-byte.
+    """
+    from ..core.sequence import SequenceBatch, value_of
+
+    output_names = _resolve_output_names(network, output_names)
+    bufs = buffers if buffers is not None else network.init_buffers()
+    flat_examples, seq_feeds = _flatten_example_feed(example_feed)
+
+    def fwd(weights, feed):
         rebuilt = {k: SequenceBatch(feed[k], feed[k + "_len"])
                    if k in seq_feeds else feed[k] for k in example_feed}
-        values, _ = network.forward(params, rebuilt, bufs,
+        values, _ = network.forward(weights, rebuilt, bufs,
                                     is_training=False, only=output_names)
         return {n: value_of(values[n]) for n in output_names}
 
-    return export_inference_fn(fn, flat_examples, dirname, output_names,
-                               batch_polymorphic=batch_polymorphic)
+    if quantize is None:
+        return export_inference_fn(
+            lambda feed: fwd(params, feed), flat_examples, dirname,
+            output_names, batch_polymorphic=batch_polymorphic)
+    enforce(quantize == "int8",
+            f"export_network: unknown quantize scheme {quantize!r} "
+            "(supported: 'int8')")
+    return _export_network_int8(
+        fwd, params, flat_examples, dirname, output_names,
+        batch_polymorphic=batch_polymorphic, dequant_dtype=dequant_dtype)
+
+
+def _export_network_int8(fwd, params, flat_examples, dirname,
+                         output_names, batch_polymorphic: bool,
+                         dequant_dtype: str) -> str:
+    """The version-2 quantized export: weights become module ARGUMENTS
+    (quantized entries at ``dequant_dtype``, raw 1-D tensors at their
+    own dtype), stored int8+scales / raw in ``weights.npz``."""
+    wnames = sorted(params)
+    feed_names = sorted(flat_examples)
+    examples = {k: np.asarray(flat_examples[k]) for k in feed_names}
+    deq_dt = np_dtype(dequant_dtype)
+
+    store: Dict[str, np.ndarray] = {}
+    entries: List[Dict[str, Any]] = []
+    warg_specs = []
+    for name in wnames:
+        arr = np.asarray(params[name])
+        if _quantizable(arr):
+            q, scale = quantize_int8(arr, axis=-1)
+            store["q::" + name] = q
+            store["s::" + name] = scale
+            arg_dt = deq_dt
+            entries.append({"name": name, "shape": list(arr.shape),
+                            "dtype": dtype_name(arg_dt),
+                            "quantized": True, "axis": -1})
+        else:
+            raw = arr.astype(np.float32) \
+                if np.issubdtype(arr.dtype, np.floating) else arr
+            store["w::" + name] = raw
+            arg_dt = raw.dtype
+            entries.append({"name": name, "shape": list(arr.shape),
+                            "dtype": dtype_name(arg_dt),
+                            "quantized": False, "axis": None})
+        warg_specs.append(jax.ShapeDtypeStruct(arr.shape, arg_dt))
+
+    nw = len(wnames)
+
+    def flat_fn(*args):
+        weights = dict(zip(wnames, args[:nw]))
+        out = fwd(weights, dict(zip(feed_names, args[nw:])))
+        return [out[n] for n in output_names]
+
+    def specs(poly: bool):
+        return warg_specs + _feed_arg_specs(examples, feed_names, poly)
+
+    exported, poly = _serialize_export(flat_fn, specs, examples,
+                                       batch_polymorphic)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, MODULE_FILE), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(dirname, WEIGHTS_FILE), **store)
+    manifest = {
+        "format": FORMAT_NAME,
+        "version": QUANT_FORMAT_VERSION,
+        "feeds": [_feed_spec(k, examples[k], poly) for k in feed_names],
+        "fetches": list(output_names),
+        "module": MODULE_FILE,
+        "batch_polymorphic": poly,
+        "weights": {
+            "file": WEIGHTS_FILE,
+            "scheme": QUANT_SCHEME,
+            "dequant_dtype": dtype_name(deq_dt),
+            "entries": entries,
+        },
+    }
+    with open(os.path.join(dirname, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    quant_bytes = sum(v.nbytes for k, v in store.items()
+                      if k.startswith(("q::", "s::")))
+    raw_bytes = sum(
+        int(np.prod(e["shape"])) * 4 for e in entries if e["quantized"])
+    log.info("int8 export: %d/%d tensors quantized, weight payload "
+             "%.2f MB (fp32 would be %.2f MB)",
+             sum(e["quantized"] for e in entries), len(entries),
+             quant_bytes / 1e6, raw_bytes / 1e6)
+    return dirname

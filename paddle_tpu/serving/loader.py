@@ -9,13 +9,20 @@ file's worth of code.
     from paddle_tpu.serving.loader import ServedModel
     model = ServedModel.load("exported_mnist/")
     probs = model(img=batch)["prediction"]
+
+Version 2 artifacts (int8 weights-only quantization, see
+``serving/export.py``) carry their weights in ``weights.npz`` instead of
+baked constants: quantized entries are dequantized ONCE at load —
+``w = q.astype(f32) * scale`` per output channel, cast to the manifest's
+``dequant_dtype`` (bf16 by default) — and prepended to every module
+call.  Version-1 artifacts load exactly as before.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 import time
 
@@ -23,7 +30,25 @@ import jax
 # explicit submodule import: pre-0.5 jax does not expose jax.export as
 # an attribute of the bare `import jax`
 import jax.export
+import jax.numpy as jnp
 import numpy as np
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype by name, bfloat16 included — plain ``np.dtype("bfloat16")``
+    raises (the type lives in ml_dtypes, re-exported by jax.numpy);
+    local on purpose so the standalone-copy deployment keeps working."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        return np.dtype(getattr(jnp, name))
+
+
+def _dequantize(q: np.ndarray, scale: np.ndarray, axis: int,
+                dtype: np.dtype) -> np.ndarray:
+    shape = [1] * q.ndim
+    shape[axis % q.ndim] = -1
+    return (q.astype(np.float32) * scale.reshape(shape)).astype(dtype)
 
 # telemetry is OPTIONAL here: paddle_tpu.observe.metrics is stdlib-only,
 # but a serving process that ships just this file (the capi-style
@@ -39,9 +64,14 @@ class ServedModel:
     the multi-thread story ``_create_shared_param`` exists for in the
     reference C API comes for free)."""
 
-    def __init__(self, manifest: Dict[str, Any], exported):
+    def __init__(self, manifest: Dict[str, Any], exported,
+                 weights: List[np.ndarray] = ()):
         self.manifest = manifest
         self._exported = exported
+        # v2: dequantized weights in call order, committed to device
+        # ONCE here — passing host numpy instead would re-pay the full
+        # weight H2D transfer on every inference call
+        self._weights = [jax.device_put(w) for w in weights]
         self.feed_names = [f["name"] for f in manifest["feeds"]]
         self.fetch_names = list(manifest["fetches"])
 
@@ -51,13 +81,27 @@ class ServedModel:
             manifest = json.load(f)
         if manifest.get("format") != "paddle-tpu-serving":
             raise ValueError(f"{dirname}: not a paddle-tpu-serving artifact")
-        if manifest.get("version", 0) > 1:
+        if manifest.get("version", 0) > 2:
             raise ValueError(
                 f"{dirname}: artifact version {manifest['version']} is newer "
-                "than this loader (supports <= 1)")
+                "than this loader (supports <= 2)")
         with open(os.path.join(dirname, manifest["module"]), "rb") as f:
             exported = jax.export.deserialize(f.read())
-        return cls(manifest, exported)
+        weights: List[np.ndarray] = []
+        wsec = manifest.get("weights")
+        if wsec:   # v2 quantized artifact: dequantize once, at load
+            npz = np.load(os.path.join(dirname, wsec["file"]))
+            for e in wsec["entries"]:
+                dt = _np_dtype(e["dtype"])
+                if e["quantized"]:
+                    ax = e.get("axis")
+                    w = _dequantize(npz["q::" + e["name"]],
+                                    npz["s::" + e["name"]],
+                                    -1 if ax is None else ax, dt)
+                else:
+                    w = np.asarray(npz["w::" + e["name"]], dtype=dt)
+                weights.append(w)
+        return cls(manifest, exported, weights)
 
     def __call__(self, **feeds) -> Dict[str, np.ndarray]:
         args = []
@@ -66,7 +110,7 @@ class ServedModel:
             if name not in feeds:
                 raise KeyError(f"missing feed {name!r} "
                                f"(expected {self.feed_names})")
-            a = np.asarray(feeds[name], dtype=spec["dtype"])
+            a = np.asarray(feeds[name], dtype=_np_dtype(spec["dtype"]))
             want = spec["shape"]
             got = list(a.shape)
             if len(want) != len(got) or any(
@@ -75,7 +119,7 @@ class ServedModel:
                     f"feed {name!r}: shape {got} incompatible with {want}")
             args.append(a)
         t0 = time.perf_counter()
-        outs = self._exported.call(*args)
+        outs = self._exported.call(*self._weights, *args)
         result = {n: np.asarray(v)
                   for n, v in zip(self.fetch_names, outs)}
         # np.asarray above synchronized the device, so this is true

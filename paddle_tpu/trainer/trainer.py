@@ -17,6 +17,7 @@ exchange with a single compiled program (SURVEY §2.5 → TPU mapping).
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -25,9 +26,11 @@ import numpy as np
 
 from ..config.model_config import OptimizationConfig
 from ..core.device import DATA_AXIS, data_sharding, get_mesh, replicated
+from ..core.dtypes import policy_for, policy_scope, resolve_precision
 from ..core.sequence import SequenceBatch, value_of
 from ..layers.network import NeuralNetwork
 from ..optimizer import Optimizer, create_optimizer, make_schedule
+from ..optimizer import loss_scale as ls
 from .. import observe
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger, global_stat
 from . import events as ev
@@ -43,6 +46,11 @@ from .checkpoint import (
 )
 
 log = get_logger("trainer")
+
+# Live trainers, for the conftest dtype-drift guard: after each precision
+# test it asserts no master parameter or optimizer-state leaf silently
+# became bf16 (the in-place-downcast bug class mixed precision invites).
+_LIVE_TRAINERS: "weakref.WeakSet[Trainer]" = weakref.WeakSet()
 
 
 def optimizer_from_config(oc: OptimizationConfig) -> Tuple[Optimizer, Callable]:
@@ -87,6 +95,15 @@ class Trainer:
         self.optimizer = optimizer
         self.mesh = mesh or get_mesh()
         self.seed = FLAGS.seed if seed is None else seed
+        # end-to-end precision policy: "fp32" (default — the legacy
+        # code path, byte-for-byte) or "bf16" (fp32 master weights,
+        # bf16 compute casts at the step boundary, dynamic loss
+        # scaling).  OptimizationConfig.precision wins over --precision.
+        self.precision = resolve_precision(opt_config)
+        self._ls_state = ls.init_state() \
+            if self.precision == "bf16" else None
+        self._skipped_reported = 0
+        _LIVE_TRAINERS.add(self)
         self.params = network.init_params(self.seed)
         self.buffers = network.init_buffers()
         self.opt_state = self.optimizer.init_state(self.params)
@@ -211,6 +228,8 @@ class Trainer:
 
     # --------------------------------------------------------- train step
     def _build_train_step(self):
+        if self.precision == "bf16":
+            return self._build_mixed_train_step()
         net = self.network
         opt = self.optimizer
         lr_scales = self._lr_scales
@@ -246,6 +265,74 @@ class Trainer:
         self._raw_step = step   # unjitted; benchmarks scan over it
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_mixed_train_step(self):
+        """The ``--precision=bf16`` train step: fp32 master weights are
+        cast to the policy compute dtype ONCE at the step boundary (the
+        backward through the cast yields fp32 gradients, so gradient
+        accumulation across shared-parameter uses happens in fp32), the
+        loss is multiplied by the dynamic scale before the backward and
+        the gradients divided by it in fp32 after, the optimizer applies
+        to the fp32 masters with fp32 slots, and a non-finite gradient
+        skips the whole update — parameters, optimizer state, and
+        buffers stay bit-identical while the scale halves.  The op-level
+        bf16 policy is entered INSIDE the traced function so every
+        retrace (new feed shape) sees it regardless of which flag or
+        config carried the policy.
+        """
+        net = self.network
+        opt = self.optimizer
+        lr_scales = self._lr_scales
+        sparse_names = {n for n, s in net.param_specs.items()
+                        if s.sparse_update}
+        pol = policy_for("bf16")
+        cd = pol.compute_dtype
+        growth_interval = FLAGS.loss_scale_growth_interval
+
+        def cast_compute(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(cd)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+        def step(params, opt_state, buffers, feed, rng, progress,
+                 ls_state):
+            with policy_scope(pol):
+                def loss_fn(p):
+                    # net.forward updates its ctx.buffers dict IN PLACE
+                    # — hand it a copy so the step's own `buffers` arg
+                    # stays pristine for the skipped-step select below
+                    # (otherwise it reads back this trace's JVP tracers)
+                    loss, (values, new_buffers) = net.loss(
+                        cast_compute(p), feed, dict(buffers),
+                        is_training=True, rng=rng)
+                    return (loss * ls_state.scale.astype(loss.dtype),
+                            (loss, new_buffers))
+
+                (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+            grads = ls.unscale(grads, ls_state.scale)
+            finite = ls.all_finite(grads)
+            if self._prune_masks:
+                from ..optimizer.hooks import apply_prune_grads
+                grads = apply_prune_grads(grads, self._prune_masks)
+            lr = self.schedule(progress)
+            masks = None
+            if sparse_names:
+                from ..parallel.sparse import touched_row_mask
+                masks = {n: (touched_row_mask(g) if n in sparse_names
+                             else None)
+                         for n, g in grads.items()}
+            new_params, new_opt = opt.apply(params, grads, opt_state,
+                                            lr, lr_scales,
+                                            sparse_masks=masks)
+            new_params = ls.select(finite, new_params, params)
+            new_opt = ls.select(finite, new_opt, opt_state)
+            new_buffers = ls.select(finite, new_buffers, buffers)
+            new_ls = ls.update(ls_state, finite, growth_interval)
+            return new_params, new_opt, new_buffers, loss, new_ls
+
+        self._raw_step = step   # unjitted; benchmarks scan over it
+        return jax.jit(step, donate_argnums=(0, 1, 2, 6))
+
     def _eval_output_names(self) -> List[str]:
         """Layers whose values evaluators should see: a declared output that
         is a cost layer stands in for its first input (the prediction) —
@@ -269,13 +356,22 @@ class Trainer:
                        for e in net.config.evaluators
                        if e.get("input_layer_name")]
 
+        # the bf16 policy also governs evaluation compute (the config-
+        # carried case: FLAGS may still say fp32, so the scope must be
+        # entered inside the traced function like the train step)
+        import contextlib
+        pol = policy_for("bf16") if self.precision == "bf16" else None
+
         def step(params, buffers, feed):
-            loss, (values, _) = net.loss(params, feed, buffers,
-                                         is_training=False)
-            outs = dict(net.outputs(values))
-            for n in eval_names:
-                if n in values:
-                    outs[n] = values[n]
+            scope = policy_scope(pol) if pol is not None \
+                else contextlib.nullcontext()
+            with scope:
+                loss, (values, _) = net.loss(params, feed, buffers,
+                                             is_training=False)
+                outs = dict(net.outputs(values))
+                for n in eval_names:
+                    if n in values:
+                        outs[n] = values[n]
             return loss, outs
 
         return jax.jit(step)
@@ -339,6 +435,9 @@ class Trainer:
             self.opt_state = self._place_opt_state(
                 self._dealias(self.opt_state), self.params)
             self.buffers = self._replicate(self._dealias(self.buffers))
+            if self._ls_state is not None:
+                self._ls_state = self._replicate(
+                    self._dealias(self._ls_state))
         t0 = time.perf_counter()
         if not placed:
             feed = self._shard_feed(feed)
@@ -347,15 +446,22 @@ class Trainer:
             (self.seed * 1000003 + self.samples_seen) % (2 ** 31))
         t_feed = time.perf_counter()
         with global_stat.timer("train_batch"):
-            self.params, self.opt_state, self.buffers, loss = \
-                self._train_step(self.params, self.opt_state, self.buffers,
-                                 feed, rng,
-                                 jnp.asarray(self.samples_seen, jnp.float32))
+            progress = jnp.asarray(self.samples_seen, jnp.float32)
+            if self._ls_state is not None:
+                (self.params, self.opt_state, self.buffers, loss,
+                 self._ls_state) = self._train_step(
+                    self.params, self.opt_state, self.buffers, feed,
+                    rng, progress, self._ls_state)
+            else:
+                self.params, self.opt_state, self.buffers, loss = \
+                    self._train_step(self.params, self.opt_state,
+                                     self.buffers, feed, rng, progress)
         self._count_recompiles()
         t_dispatch = time.perf_counter()
         if observe.active():
             jax.block_until_ready(loss)
             t_done = time.perf_counter()
+            self._sync_precision_metrics()   # fenced anyway: keep fresh
             observe.histogram(
                 "train_device_blocked_seconds",
                 "time blocked on the device per step (fenced; only "
@@ -380,6 +486,29 @@ class Trainer:
         observe.counter("train_samples", "samples trained").inc(batch)
         self.samples_seen += batch
         return loss  # device scalar: don't block — caller decides when
+
+    def _sync_precision_metrics(self) -> None:
+        """Drain the device-side loss-scale state into observe: the
+        ``loss_scale`` gauge and the ``loss_scale_skipped_steps_total``
+        counter delta.  Costs a D2H sync, so the hot loop calls it only
+        at pass boundaries (and per-step when a metrics sink already
+        fences the step); no-op under ``--precision=fp32``."""
+        if self._ls_state is None:
+            return
+        observe.gauge(
+            "loss_scale",
+            "current dynamic loss scale (--precision=bf16; grows 2x "
+            "per overflow-free growth interval, halves on inf/nan "
+            "gradients)").set(float(self._ls_state.scale))
+        skipped = int(self._ls_state.skipped_total)
+        delta = skipped - self._skipped_reported
+        if delta > 0:
+            observe.counter(
+                "loss_scale_skipped_steps_total",
+                "train steps skipped on non-finite gradients "
+                "(parameters and optimizer state left untouched)"
+            ).inc(delta)
+            self._skipped_reported = skipped
 
     # --------------------------------------------------------- main loops
     def train(self, reader, num_passes: int = 1,
@@ -444,6 +573,7 @@ class Trainer:
             finally:
                 if pipe is not None:
                     pipe.close()
+            self._sync_precision_metrics()   # pass boundary: one sync
             if wait_s + busy_s > 0:
                 observe.gauge(
                     "input_bound_ratio",
@@ -606,9 +736,17 @@ class Trainer:
 
     # -------------------------------------------------------- persistence
     def save(self, save_dir: str, pass_id: int) -> str:
+        meta: Dict[str, Any] = {"samples_seen": self.samples_seen}
+        if self._ls_state is not None:
+            # persist the dynamic loss scale so resume keeps the warmed
+            # scale instead of replaying the whole backoff search
+            meta["loss_scale"] = {
+                "scale": float(self._ls_state.scale),
+                "growth_count": int(self._ls_state.growth_count),
+                "skipped_total": int(self._ls_state.skipped_total),
+            }
         return save_checkpoint(save_dir, pass_id, self.params,
-                               self.opt_state, self.buffers,
-                               meta={"samples_seen": self.samples_seen})
+                               self.opt_state, self.buffers, meta=meta)
 
     def load(self, ckpt_dir: str, _verified: bool = False) -> None:
         # _verified: resume() already digest-checked this dir via
@@ -636,7 +774,17 @@ class Trainer:
         if opt is not None:
             self.opt_state = opt
         try:
-            self.samples_seen = load_manifest(ckpt_dir).get("samples_seen", 0)
+            man = load_manifest(ckpt_dir)
+            self.samples_seen = man.get("samples_seen", 0)
+            if self._ls_state is not None and "loss_scale" in man:
+                m = man["loss_scale"]
+                self._ls_state = ls.LossScaleState(
+                    scale=jnp.asarray(float(m["scale"]), jnp.float32),
+                    growth_count=jnp.asarray(
+                        int(m.get("growth_count", 0)), jnp.int32),
+                    skipped_total=jnp.asarray(
+                        int(m.get("skipped_total", 0)), jnp.int32))
+                self._skipped_reported = int(m.get("skipped_total", 0))
         except FileNotFoundError:
             pass
         if getattr(self, "_prune_masks", None):

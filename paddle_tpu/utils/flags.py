@@ -112,6 +112,24 @@ FLAGS.define("init_model_path", "", "checkpoint dir to warm-start from")
 FLAGS.define("start_pass", 0, "first pass number (resume)")
 FLAGS.define("save_dir", "./output", "checkpoint output dir")
 FLAGS.define("config_args", "", "comma-sep k=v pairs visible to configs")
+FLAGS.define("precision", "fp32",
+             "end-to-end training precision policy: fp32 | bf16.  "
+             "bf16 = mixed precision — fp32 master weights cast to "
+             "bfloat16 compute at the train-step boundary, fp32 "
+             "optimizer state and gradient accumulation, dynamic loss "
+             "scaling with skipped-step semantics on non-finite grads "
+             "(trainer/trainer.py + optimizer/loss_scale.py), and the "
+             "op-level compute policy (core/dtypes.py) forced to bf16 "
+             "regardless of --use_bf16.  fp32 (the default) leaves the "
+             "legacy --use_bf16/--bf16_activations resolution untouched "
+             "byte-for-byte")
+FLAGS.define("loss_scale_init", 32768.0,
+             "initial dynamic loss scale under --precision=bf16 "
+             "(2^15; grows 2x every --loss_scale_growth_interval "
+             "overflow-free steps, halves — floor 1.0 — and skips the "
+             "step on inf/nan gradients)")
+FLAGS.define("loss_scale_growth_interval", 2000,
+             "overflow-free steps between dynamic loss-scale doublings")
 FLAGS.define("use_bf16", True, "run matmul/conv compute in bfloat16 on TPU")
 FLAGS.define("bf16_activations", False,
              "store layer activations in bfloat16 (halves activation HBM "

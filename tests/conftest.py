@@ -193,3 +193,47 @@ def _io_thread_leak_guard(request):
     if os.environ.get("PADDLE_TPU_THREAD_GUARD_STRICT") == "1":
         pytest.fail(msg)
     warnings.warn(msg)
+
+
+# Dtype-drift guard: under the --precision=bf16 policy, master
+# parameters and optimizer state must STAY fp32 — an accidental in-place
+# downcast (assigning a compute-cast tree back onto the trainer) is the
+# classic mixed-precision bug and silently destroys convergence.  After
+# each test, every live Trainer's params + opt-state leaves are checked
+# for half-precision dtypes; violations LOUD-WARN by default (the same
+# escalation contract as the thread-leak guard above), and
+# PADDLE_TPU_DTYPE_GUARD_STRICT=1 turns them into failures.
+@pytest.fixture(autouse=True)
+def _master_dtype_drift_guard(request):
+    import sys
+    import warnings
+
+    yield
+    trainer_mod = sys.modules.get("paddle_tpu.trainer.trainer")
+    if trainer_mod is None:          # test never touched the trainer
+        return
+    import jax
+    import jax.numpy as jnp
+
+    half = (jnp.bfloat16, np.float16)
+    bad = []
+    for tr in list(trainer_mod._LIVE_TRAINERS):
+        for tag, tree in (("params", getattr(tr, "params", None)),
+                          ("opt_state", getattr(tr, "opt_state", None))):
+            if tree is None:
+                continue
+            for path, leaf in \
+                    jax.tree_util.tree_flatten_with_path(tree)[0]:
+                if getattr(leaf, "dtype", None) in half:
+                    bad.append(f"{tag}{jax.tree_util.keystr(path)}"
+                               f"={leaf.dtype}")
+    if not bad:
+        return
+    msg = (f"MASTER DTYPE DRIFT after {request.node.nodeid}: "
+           f"{sorted(set(bad))[:8]} — a master parameter or "
+           "optimizer-state leaf ended up half-precision (in-place "
+           "downcast through the bf16 compute path); set "
+           "PADDLE_TPU_DTYPE_GUARD_STRICT=1 to fail on this")
+    if os.environ.get("PADDLE_TPU_DTYPE_GUARD_STRICT") == "1":
+        pytest.fail(msg)
+    warnings.warn(msg)
