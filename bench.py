@@ -41,6 +41,11 @@ Six workloads (the first printed line is the driver-parsed metric):
    CPU-scale shapes.  Every emitted JSON line (all lanes) now carries
    a ``precision_policy`` stamp with the resolved per-op dispatch
    dtypes.
+9. **tracing overhead A/B** (round 13) — traced (``--trace_jsonl`` +
+   flight recorder) vs untraced training on a small LSTM row, both
+   modes per-step fenced; stamps ``trace_overhead_us_per_step``
+   (enabled tax) and ``trace_disabled_us_per_step`` (the no-op span
+   machinery, acceptance < 50 µs/step).  See :func:`bench_observe`.
 
 Each train step is ONE jitted XLA computation (fwd + autodiff bwd +
 Adam).  Timing chains K steps inside one ``lax.scan`` program (see
@@ -990,6 +995,103 @@ def bench_precision():
     })
 
 
+def bench_observe():
+    """Tracing-overhead A/B (`--only observe`, round 13): the SAME
+    small LSTM row steps untraced (the production default — no sink, no
+    port, `span()` is a shared no-op) vs traced (JSONL sink + flight
+    recorder), per-step fenced in BOTH modes so the delta is tracing
+    cost, not fencing asymmetry.  `trace_overhead_us_per_step` is the
+    enabled-mode tax; `trace_disabled_us_per_step` measures the no-op
+    span machinery directly (span count of one hot-path step × the
+    measured per-call cost) — the <50 µs/step acceptance bound of the
+    disabled-mode contract.  The traced run's file is parsed back
+    (`json.load`) to certify the Chrome trace-event stream."""
+    import json as _json
+    import os as _os
+    import tempfile
+
+    from paddle_tpu.core.device import build_mesh, set_mesh
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.observe import trace
+
+    # deliberately small: the A/B resolves a per-step tax of tens of µs,
+    # so the step itself must be a few ms, not hundreds (CPU boxes run
+    # the scan tier here; the tax being measured is host-side anyway)
+    B, T, H, V, E = 16, 16, 64, 500, 32
+    devices = jax.devices()
+    mesh = build_mesh({"data": 1}, devices[:1])
+    set_mesh(mesh)
+    cfg = lstm_text_classifier(vocab_size=V, embed_dim=E, hidden_size=H,
+                               lstm_num=2, num_classes=2)
+    trainer = _mk_trainer(cfg, mesh=mesh)
+    rng = np.random.RandomState(0)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
+                jax.numpy.asarray(
+                    rng.randint(T // 2, T + 1, (B,)).astype(np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (B,)).astype(np.int32))}
+
+    def measure_ms(steps=60, warmup=8):
+        for _ in range(warmup):
+            float(trainer.train_one_batch(feed))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            float(trainer.train_one_batch(feed))   # float() = fence
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    trace_path = _os.path.join(tempfile.mkdtemp(prefix="ptpu-bench-obs-"),
+                               "trace.json")
+    # interleave attempts so drift (thermal, competing load) hits both
+    # modes equally; per-mode median is the row value
+    off_ms, on_ms = [], []
+    for _ in range(5):
+        trace.disable()
+        off_ms.append(measure_ms())
+        trace.enable(jsonl_path=trace_path,
+                     ring_size=FLAGS.get("trace_ring_size"))
+        on_ms.append(measure_ms())
+    trace.disable()
+    with open(trace_path) as f:
+        events = _json.load(f)
+    overhead_us = (float(np.median(on_ms)) - float(np.median(off_ms))) \
+        * 1e3
+
+    # disabled-mode contract: measure the no-op span() directly and
+    # scale by one step's span count (train_step, feed, step_dispatch,
+    # input_wait + one spare for pipeline/fence variants)
+    spans_per_step = 5
+    n_calls = 20000
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        with trace.span("bench_noop"):
+            pass
+    disabled_us = (time.perf_counter() - t0) / n_calls * 1e6 \
+        * spans_per_step
+
+    return _with_band({
+        "metric": "observe_trace_overhead_us_per_step",
+        "value": round(overhead_us, 1),
+        "unit": ("traced − untraced per-step wall time, µs (LSTM "
+                 f"bs={B} hidden={H} T={T}, fenced both modes)"),
+        "trace_overhead_us_per_step": round(overhead_us, 1),
+        "trace_disabled_us_per_step": round(disabled_us, 2),
+        "disabled_target_us": 50.0,
+        "passed": disabled_us < 50.0,
+        "ms_untraced": [round(v, 3) for v in off_ms],
+        "ms_traced": [round(v, 3) for v in on_ms],
+        "trace_events": len(events),
+        "trace_file_valid": all(
+            k in e for e in events
+            for k in ("ph", "ts", "dur", "pid", "tid", "name")),
+        "devices": _n_chips(trainer),
+        # per-mode attempt lists above carry the variability; the
+        # signed per-attempt deltas would make the band's relative
+        # spread meaningless, so the band is the median alone
+    })
+
+
 def _precision_stamp():
     """Active precision policy + resolved per-op dispatch dtypes,
     stamped on EVERY emitted JSON line (the round-8 `path`-field
@@ -1028,7 +1130,7 @@ def main():
     ap.add_argument("--only",
                     choices=["lstm", "resnet", "seq2seq", "attention",
                              "lstm1280", "lstm2048", "pipeline",
-                             "precision"])
+                             "precision", "observe"])
     ap.add_argument("--pipeline_small", action="store_true",
                     help="run the input-pipeline A/B lane at CPU-"
                          "runnable shapes (the JSON line records "
@@ -1065,11 +1167,12 @@ def main():
     benches = {"lstm": bench_lstm, "resnet": bench_resnet,
                "seq2seq": bench_seq2seq, "attention": bench_attention,
                "lstm1280": bench_lstm_1280, "lstm2048": bench_lstm_2048,
-               "pipeline": bench_pipeline, "precision": bench_precision}
+               "pipeline": bench_pipeline, "precision": bench_precision,
+               "observe": bench_observe}
     order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
                                            "attention", "lstm1280",
                                            "lstm2048", "pipeline",
-                                           "precision"]
+                                           "precision", "observe"]
     for name in order:
         try:
             before = observe.REGISTRY.flat(kinds=("counter",))

@@ -327,6 +327,7 @@ class MasterService {
 
   std::string HandleLine(const std::string& line);
   std::string HandleLineImpl(const std::string& line);
+  std::string HandleFramed(const std::string& line);
   void ServerLoop();
 
   std::mutex mu_;
@@ -364,6 +365,9 @@ class MasterService {
 // SAVE\t<trainer>\t<sec>  -> 1 | 0   (sec < 0: owner releases the window)
 // COUNTS                  -> <todo>\t<pending>\t<done>\t<failed>
 // PING                    -> PONG  (liveness probe, no state touched)
+// CTX\t<opaque>\t<line>   -> CTX\t<opaque>\t<pid>\t<us>\t<resp>
+//                            (trace-context frame around any request;
+//                            see HandleFramed below)
 //
 // Every request gets exactly one response line; a malformed request gets
 // ERR and the connection stays usable.  Reconnecting clients may replay
@@ -377,6 +381,33 @@ std::string MasterService::HandleLine(const std::string& line) {
     // a malformed request must never take down the service
     return std::string("ERR\t") + e.what();
   }
+}
+
+// Trace-context framing: "CTX\t<opaque>\t<request line>" wraps any
+// protocol request; the response echoes the opaque token (a tracing
+// client's trace_id/span_id — never interpreted here) together with
+// this process's pid and the server-side handling time in microseconds:
+// "CTX\t<opaque>\t<pid>\t<us>\t<response line>".  The client records a
+// master-side span from the echo, so the lease handling lands in the
+// same distributed trace as the trainer's RPC span.  Clients that don't
+// trace never send CTX and see the protocol unchanged; a CTX line with
+// no inner request falls through to HandleLine (=> ERR) like any other
+// malformed input.
+std::string MasterService::HandleFramed(const std::string& line) {
+  if (line.rfind("CTX\t", 0) == 0) {
+    size_t sep = line.find('\t', 4);
+    if (sep != std::string::npos) {
+      std::string opaque = line.substr(4, sep - 4);
+      auto t0 = Clock::now();
+      std::string resp = HandleLine(line.substr(sep + 1));
+      auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                    Clock::now() - t0)
+                    .count();
+      return "CTX\t" + opaque + "\t" + std::to_string(getpid()) + "\t" +
+             std::to_string(us) + "\t" + resp;
+    }
+  }
+  return HandleLine(line);
 }
 
 std::string MasterService::HandleLineImpl(const std::string& line) {
@@ -471,7 +502,7 @@ void MasterService::ServerLoop() {
           std::string line = buf.substr(0, pos);
           buf.erase(0, pos + 1);
           if (!line.empty() && line.back() == '\r') line.pop_back();
-          std::string resp = HandleLine(line) + "\n";
+          std::string resp = HandleFramed(line) + "\n";
           ssize_t off = 0;
           while (off < static_cast<ssize_t>(resp.size())) {
             ssize_t w = write(fd, resp.data() + off, resp.size() - off);

@@ -241,6 +241,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "snapshot line here every "
                          "--metrics_interval_s seconds")
     tp.add_argument("--metrics_interval_s", type=float, default=None)
+    tp.add_argument("--trace_jsonl", default="",
+                    help="span-trace sink: stream every span (step "
+                         "phases, pipeline workers, master RPCs, "
+                         "checkpoints) here as Chrome trace-event "
+                         "JSON, loadable in Perfetto")
+    tp.add_argument("--metrics_port", type=int, default=None,
+                    help="serve /metrics + /healthz + /trace on this "
+                         "loopback port during the run (0 = off)")
+    tp.add_argument("--debug_dump_signal", action="store_true",
+                    help="SIGUSR2 dumps metrics + flight-recorder "
+                         "trace of the live run to --debug_dump_dir")
     tp.set_defaults(fn=cmd_train)
 
     mp = sub.add_parser(
@@ -310,9 +321,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         FLAGS.set("metrics_jsonl", args.metrics_jsonl)
     if getattr(args, "metrics_interval_s", None) is not None:
         FLAGS.set("metrics_interval_s", args.metrics_interval_s)
-    if FLAGS.get("metrics_jsonl"):
-        from . import observe
-        observe.start_from_flags()
+    if getattr(args, "trace_jsonl", ""):
+        FLAGS.set("trace_jsonl", args.trace_jsonl)
+    if getattr(args, "metrics_port", None) is not None:
+        FLAGS.set("metrics_port", args.metrics_port)
+    if getattr(args, "debug_dump_signal", False):
+        FLAGS.set("debug_dump_signal", True)
+    # umbrella: --metrics_jsonl reporter, --trace_jsonl span sink,
+    # --metrics_port endpoint, --debug_dump_signal handler — each a
+    # no-op when its flag is unset (no thread starts)
+    from . import observe
+    observe.start_from_flags()
     return args.fn(args)
 
 

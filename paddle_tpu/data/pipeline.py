@@ -36,6 +36,13 @@ Telemetry (``paddle_tpu/observe``): ``pipeline_queue_depth`` gauge
 ``pipeline_prefetch_stalls_total`` counters (was the next batch ready
 when the consumer asked?), and the ``pipeline_worker_convert_seconds``
 histogram (per-batch convert+place time on the worker threads).
+
+Tracing (:mod:`paddle_tpu.observe.trace`): worker threads adopt the
+trace context active when the pipeline was constructed (the trainer's
+``train_pass`` span), so each ``pipeline_read`` (source pull — reader
+IO, master lease RPCs) and ``pipeline_convert`` (convert + H2D place,
+indexed by batch) span lands in the consuming pass's trace, one lane
+per worker thread in Perfetto.
 """
 
 from __future__ import annotations
@@ -45,6 +52,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from .. import observe
+from ..observe import trace
 from ..utils import get_logger
 
 log = get_logger("pipeline")
@@ -98,6 +106,11 @@ class AsyncPipeline:
         self.workers = max(1, min(int(workers), depth))
         self.name = name
 
+        # worker threads adopt the CREATING thread's trace context
+        # (thread-locals don't inherit), so reader/convert/place spans
+        # land in the trace of the pass that consumes them
+        self._trace_ctx = trace.current_context()
+
         self._src_lock = threading.Lock()   # serializes next(_src)
         self._cond = threading.Condition()  # guards the state below
         self._ready: dict = {}              # index -> (feed, exc|None)
@@ -143,7 +156,8 @@ class AsyncPipeline:
                     return None
                 i = self._seq
             try:
-                raw = next(self._src)
+                with trace.span("pipeline_read"):
+                    raw = next(self._src)
             except StopIteration:
                 with self._cond:
                     if self._end_at is None:
@@ -161,6 +175,10 @@ class AsyncPipeline:
             return i, raw
 
     def _worker(self) -> None:
+        with trace.context_scope(self._trace_ctx):
+            self._worker_loop()
+
+    def _worker_loop(self) -> None:
         while True:
             # a credit bounds in-flight batches; poll so close() is
             # never stuck behind a full queue
@@ -176,9 +194,10 @@ class AsyncPipeline:
             i, raw = item
             t0 = time.perf_counter()
             try:
-                feed = self._convert(raw) if self._convert else raw
-                if self._place is not None:
-                    feed = self._place(feed)
+                with trace.span("pipeline_convert", index=i):
+                    feed = self._convert(raw) if self._convert else raw
+                    if self._place is not None:
+                        feed = self._place(feed)
                 out = (feed, None)
             except BaseException as exc:  # convert fault: deliver at i
                 out = (None, exc)

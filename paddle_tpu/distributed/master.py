@@ -21,7 +21,7 @@ import time
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
-from ..observe import counter, gauge
+from ..observe import counter, gauge, trace
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger
 
 log = get_logger("master")
@@ -224,6 +224,11 @@ class MasterClient:
             ^ next(_client_nonce))
         self._buf = b""
         self._closed = False
+        # trace-context framing capability: assumed until a master
+        # answers a CTX frame with a bare ERR (pre-CTX binary) — then
+        # this client stops framing so tracing never breaks the RPCs
+        # it is meant to observe
+        self._ctx_frames = True
         # the initial dial keeps today's fail-fast semantics: a wrong
         # address should error immediately, not burn a retry budget
         self._sock: Optional[socket.socket] = socket.create_connection(
@@ -244,47 +249,105 @@ class MasterClient:
         retry_max = (self._retry_max if retry_override is None
                      else retry_override)
         attempt = 0
-        while True:
-            try:
-                if self._sock is None:
-                    self._sock = socket.create_connection(
-                        self._addr, timeout=self._timeout)
-                    self._buf = b""
-                self._sock.sendall(line.encode() + b"\n")
-                while b"\n" not in self._buf:
-                    chunk = self._sock.recv(4096)
-                    if not chunk:
-                        raise ConnectionResetError(
-                            "master closed the connection")
-                    self._buf += chunk
-                resp, self._buf = self._buf.split(b"\n", 1)
-                if attempt:   # request survived via reconnect + replay
-                    counter("master_replays",
-                            "master RPCs completed on a replay after "
-                            "reconnect").inc()
-                return resp.decode()
-            except OSError as e:  # incl. ConnectionError, socket.timeout
-                self._drop_sock()
-                if attempt >= retry_max:
-                    counter("master_giveups",
-                            "master RPCs that exhausted the reconnect "
-                            "budget and raised").inc()
-                    raise PaddleTpuError("master connection closed") from e
-                delay = min(self._retry_cap_s,
-                            self._retry_base_s * (2 ** attempt))
-                delay *= 0.5 + self._rng.random()  # jitter: [0.5, 1.5)x
-                attempt += 1
-                counter("master_reconnects",
-                        "master connection losses answered with a "
-                        "re-dial (per retry attempt)").inc()
-                counter("master_backoff_seconds",
-                        "total backoff slept before master re-dials"
-                        ).inc(delay)
-                log.warning(
-                    "master call %s failed (%s: %s); reconnect attempt "
-                    "%d/%d in %.2fs", line.split("\t", 1)[0],
-                    type(e).__name__, e, attempt, retry_max, delay)
-                time.sleep(delay)
+        op = line.split("\t", 1)[0]
+        # one span covers the whole call incl. reconnect+replay; when
+        # tracing is on the request rides a CTX frame so the master's
+        # own handling comes back as a server-side span in this trace
+        with trace.span("master_rpc", op=op):
+            while True:
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            self._addr, timeout=self._timeout)
+                        self._buf = b""
+                    wire = line
+                    framed = False
+                    if trace.enabled() and self._ctx_frames:
+                        hdr = trace.parent_header()
+                        if hdr:
+                            wire = f"CTX\t{hdr}\t{line}"
+                            framed = True
+                    t_send = trace.now_us()
+                    self._sock.sendall(wire.encode() + b"\n")
+                    while b"\n" not in self._buf:
+                        chunk = self._sock.recv(4096)
+                        if not chunk:
+                            raise ConnectionResetError(
+                                "master closed the connection")
+                        self._buf += chunk
+                    resp, self._buf = self._buf.split(b"\n", 1)
+                    if attempt:   # request survived via reconnect+replay
+                        counter("master_replays",
+                                "master RPCs completed on a replay after "
+                                "reconnect").inc()
+                    resp_s = resp.decode()
+                    if framed and resp_s.startswith("ERR"):
+                        # a pre-CTX master parsed "CTX" as the op and
+                        # errored without touching state: stop framing
+                        # and replay this request bare (one extra round
+                        # trip, once per client)
+                        from ..utils.logger import warn_once
+                        self._ctx_frames = False
+                        warn_once(
+                            f"master_no_ctx:{self._addr}",
+                            "master %s:%d predates trace-context "
+                            "framing; tracing continues client-side "
+                            "only (no server-side spans)", *self._addr,
+                            logger=log)
+                        continue
+                    if resp_s.startswith("CTX\t"):
+                        resp_s = self._absorb_ctx_echo(
+                            resp_s, t_send, trace.now_us(), op)
+                    return resp_s
+                except OSError as e:  # incl. ConnectionError, timeout
+                    self._drop_sock()
+                    if attempt >= retry_max:
+                        counter("master_giveups",
+                                "master RPCs that exhausted the "
+                                "reconnect budget and raised").inc()
+                        raise PaddleTpuError(
+                            "master connection closed") from e
+                    delay = min(self._retry_cap_s,
+                                self._retry_base_s * (2 ** attempt))
+                    delay *= 0.5 + self._rng.random()  # jitter [0.5,1.5)
+                    attempt += 1
+                    counter("master_reconnects",
+                            "master connection losses answered with a "
+                            "re-dial (per retry attempt)").inc()
+                    counter("master_backoff_seconds",
+                            "total backoff slept before master re-dials"
+                            ).inc(delay)
+                    log.warning(
+                        "master call %s failed (%s: %s); reconnect "
+                        "attempt %d/%d in %.2fs", op,
+                        type(e).__name__, e, attempt, retry_max, delay)
+                    with trace.span("master_backoff", op=op,
+                                    attempt=attempt):
+                        time.sleep(delay)
+
+    @staticmethod
+    def _absorb_ctx_echo(resp: str, t_send_us: float, t_recv_us: float,
+                         op: str) -> str:
+        """Unwrap a ``CTX\\t<opaque>\\t<pid>\\t<us>\\t<response>`` echo
+        and record the master's handling as a server-side span of the
+        echoed context (clock skew sidestepped: the span is placed at
+        the midpoint of the client-observed round trip, its duration is
+        the server-measured one).  Anything malformed passes through
+        untouched — trace framing must never corrupt the protocol."""
+        try:
+            _, hdr, pid_s, us_s, rest = resp.split("\t", 4)
+            dur_us = float(us_s)
+            server_pid = int(pid_s)
+        except ValueError:
+            return resp
+        ctx = trace.parse_header(hdr)
+        if ctx is not None:
+            slack = max(0.0, (t_recv_us - t_send_us) - dur_us)
+            trace.record_span(
+                "master.handle", t_send_us + slack / 2.0, dur_us,
+                ctx.trace_id, parent_id=ctx.span_id, pid=server_pid,
+                tid=server_pid, op=op)
+        return rest
 
     def ping(self) -> bool:
         """Cheap liveness probe (PING op; no master state touched).
@@ -462,11 +525,18 @@ def _readahead_reader(client, load_fn, wait_sleep: float,
         call_lock = threading.Lock()   # one socket, two threads
         tids_lock = threading.Lock()
         open_tids: set = set()         # leased, not yet FIN/FAILed
+        # the fetcher adopts the consuming pass's trace context so its
+        # lease RPCs + chunk loads land in that trace, not a fresh one
+        trace_ctx = trace.current_context()
 
         def _put(item) -> bool:
             return _put_until(out_q, item, stop)
 
         def fetcher():
+            with trace.context_scope(trace_ctx):
+                _fetch_loop()
+
+        def _fetch_loop():
             try:
                 while not stop.is_set():
                     with call_lock:
@@ -479,7 +549,8 @@ def _readahead_reader(client, load_fn, wait_sleep: float,
                     with tids_lock:
                         open_tids.add(tid)
                     try:
-                        samples = list(load_fn(payload))
+                        with trace.span("master_load_chunk", task=tid):
+                            samples = list(load_fn(payload))
                     except Exception as exc:   # shard fault: re-queue,
                         with tids_lock:        # then re-raise consumer-
                             open_tids.discard(tid)  # side

@@ -44,13 +44,39 @@ from .report import (  # noqa: F401
     active,
     attach,
     prometheus_dump,
-    start_from_flags,
-    stop_global,
 )
+from .report import start_from_flags as _start_reporter_from_flags
+from .report import stop_global as _stop_reporter_global
+from . import dump, http, trace  # noqa: F401 — submodule API
+
+
+def start_from_flags():
+    """One call a long-running entry point makes (``Trainer.train``,
+    ``bench.main``, the CLI): start every flag-configured observability
+    surface — the ``--metrics_jsonl`` reporter, ``--trace_jsonl`` span
+    sink, the ``--metrics_port`` HTTP endpoint, and the
+    ``--debug_dump_signal`` SIGUSR2 handler.  Each piece is individually
+    idempotent and a no-op when its flag is unset, so with nothing
+    configured this is a few dict lookups and no thread starts."""
+    reporter = _start_reporter_from_flags()
+    trace.start_from_flags()
+    http.start_from_flags()
+    dump.install_from_flags()
+    return reporter
+
+
+def stop_global():
+    """Stop every process-wide observability surface (reporter + HTTP
+    endpoint + trace sink) — the mirror of :func:`start_from_flags`."""
+    _stop_reporter_global()
+    http.stop_global()
+    trace.disable()
+
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "REGISTRY", "counter", "gauge", "histogram",
     "format_labels", "MetricsReporter", "active", "attach",
     "prometheus_dump", "start_from_flags", "stop_global",
+    "trace", "http", "dump",
 ]

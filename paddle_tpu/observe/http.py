@@ -1,0 +1,173 @@
+"""Live observability endpoint: ``/metrics`` + ``/healthz`` + ``/trace``.
+
+A stdlib ``http.server`` thread (name ``ptpu-metrics-http``; the
+conftest thread-leak guard keys on it) behind ``--metrics_port`` makes
+a live run scrapeable without the JSONL sinks:
+
+- ``GET /metrics``  — Prometheus exposition text: the typed registry +
+  the ``StatSet`` timer table (:func:`paddle_tpu.observe.prometheus_dump`);
+- ``GET /healthz``  — liveness JSON (``{"status": "ok", ...}`` with pid
+  and uptime), for load-balancer / k8s probes;
+- ``GET /trace``    — the flight recorder as a Chrome trace-event JSON
+  array, loadable directly in Perfetto — "what were the last N spans of
+  this live run" without attaching a debugger.
+
+Zero-dependency rule: nothing here imports jax.  Starting the server
+does NOT enable tracing: the first ``/trace`` request flips on
+ring-only recording (``trace.ensure_ring``) — an opt-in at scrape
+time, so a run that only serves ``/metrics`` never pays the tracing
+fence.  With neither ``--metrics_port`` nor ``--trace_jsonl``
+configured no thread starts and the hot-path instrumentation stays
+no-op.
+
+The handler never raises into the serving loop (telemetry never kills
+— a scrape that fails returns 500 with the error text), binds loopback
+only (metrics are not an external API), and every request runs on a
+short-lived daemon thread (``ThreadingHTTPServer``), so a slow scraper
+cannot wedge the trainer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import trace
+from .report import prometheus_dump
+
+#: Serve-loop thread name (conftest thread-leak guard entry).
+SERVER_THREAD_NAME = "ptpu-metrics-http"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-observe"
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, prometheus_dump(),
+                           "text/plain; version=0.0.4")
+            elif path == "/healthz":
+                self._send(200, json.dumps({
+                    "status": "ok", "pid": os.getpid(),
+                    "uptime_s": round(
+                        time.monotonic() - self.server.t0, 3),
+                    "trace_enabled": trace.enabled(),
+                    "trace_spans_dropped": trace.dropped_count(),
+                }), "application/json")
+            elif path == "/trace":
+                # lazy opt-in: the FIRST /trace request enables
+                # ring-only recording — fence-free (trace.fences_steps
+                # stays False), so a probe of this endpoint never
+                # converts the trainer's async dispatch into per-step
+                # device syncs; a run only ever scraped for /metrics
+                # never records at all
+                trace.ensure_ring()
+                self._send(200, trace.flight_recorder_json(),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": "unknown path",
+                     "paths": ["/metrics", "/healthz", "/trace"]}),
+                    "application/json")
+        except BrokenPipeError:      # scraper hung up mid-response
+            pass
+        except Exception as e:       # noqa: BLE001 — never kill serving
+            try:
+                self._send(500, f"observability handler error: {e}\n",
+                           "text/plain")
+            except OSError:
+                pass
+
+    def log_message(self, fmt: str, *args) -> None:
+        from ..utils.logger import get_logger
+
+        get_logger("observe.http").debug("http %s", fmt % args)
+
+
+class ObservabilityServer:
+    """The ``/metrics`` + ``/healthz`` + ``/trace`` server thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.t0 = time.monotonic()
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ObservabilityServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name=SERVER_THREAD_NAME, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._httpd.shutdown()
+            t.join(timeout=5.0)
+        self._httpd.server_close()
+
+    def __enter__(self) -> "ObservabilityServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+_global: Optional[ObservabilityServer] = None
+_global_lock = threading.Lock()
+
+
+def start_from_flags() -> Optional[ObservabilityServer]:
+    """Start the process-wide endpoint iff ``--metrics_port`` > 0
+    (port 0 disables; use :class:`ObservabilityServer` directly for an
+    ephemeral-port server in tests).  Idempotent.  A port that cannot
+    be bound warns once and leaves the process running — telemetry
+    never kills the run it observes."""
+    global _global
+    from ..utils import FLAGS
+    from ..utils.logger import get_logger, warn_once
+
+    port = int(FLAGS.get("metrics_port"))
+    if port <= 0:
+        return _global
+    with _global_lock:
+        if _global is None:
+            try:
+                _global = ObservabilityServer(port).start()
+            except OSError as e:
+                warn_once(
+                    f"metrics_port_bind_failed:{port}",
+                    "--metrics_port %d could not be bound (%s); the "
+                    "observability endpoint is OFF for this run",
+                    port, e, logger=get_logger("observe"))
+                return None
+            get_logger("observe").info(
+                "observability endpoint on http://127.0.0.1:%d "
+                "(/metrics /healthz /trace)", _global.port)
+    return _global
+
+
+def stop_global() -> None:
+    global _global
+    with _global_lock:
+        srv, _global = _global, None
+    if srv is not None:
+        srv.stop()

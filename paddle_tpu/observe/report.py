@@ -54,6 +54,12 @@ class MetricsReporter:
         self.interval_s = interval_s
         self.registry = REGISTRY if registry is None else registry
         self.stat = stat
+        # a sink that cannot be written is DEGRADED: snapshots are being
+        # dropped, so active() must stop claiming someone is listening —
+        # otherwise the trainer keeps paying block_until_ready step
+        # fencing for telemetry that never lands.  A later successful
+        # flush (path fixed, disk freed) clears the state.
+        self.degraded = False
         self._seq = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -78,8 +84,14 @@ class MetricsReporter:
         with self._lock:
             line = self.snapshot_line()
             self._seq += 1
-            with open(self.path, "a") as f:
-                f.write(json.dumps(line) + "\n")
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(line) + "\n")
+            except Exception as e:   # noqa: BLE001 — mark + re-raise:
+                self.degraded = True  # the loop warns-once, direct
+                self._warn_flush_failure(e)  # callers see the error
+                raise
+            self.degraded = False
         return line
 
     # ---------------------------------------------------------- prometheus
@@ -200,10 +212,14 @@ def stop_global() -> None:
 
 
 def active() -> bool:
-    """True iff a sink is attached — instrumentation whose cost is NOT
-    negligible (device fencing for the host/device split) keys on this,
-    so telemetry is effectively free when nobody is listening."""
-    return _global is not None and bool(_global.path)
+    """True iff a sink is attached AND writable — instrumentation whose
+    cost is NOT negligible (device fencing for the host/device split)
+    keys on this, so telemetry is effectively free when nobody is
+    listening.  A degraded sink (every flush failing — bad path, full
+    disk) reports False: nobody IS listening, so the hot loop must not
+    keep paying for snapshots that are being dropped."""
+    return _global is not None and bool(_global.path) \
+        and not _global.degraded
 
 
 def prometheus_dump() -> str:

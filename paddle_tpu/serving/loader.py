@@ -20,6 +20,7 @@ call.  Version-1 artifacts load exactly as before.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Any, Dict, List
@@ -50,13 +51,14 @@ def _dequantize(q: np.ndarray, scale: np.ndarray, axis: int,
     shape[axis % q.ndim] = -1
     return (q.astype(np.float32) * scale.reshape(shape)).astype(dtype)
 
-# telemetry is OPTIONAL here: paddle_tpu.observe.metrics is stdlib-only,
-# but a serving process that ships just this file (the capi-style
-# deployment story) runs fine without it
+# telemetry is OPTIONAL here: paddle_tpu.observe is stdlib-only, but a
+# serving process that ships just this file (the capi-style deployment
+# story) runs fine without it
 try:
     from ..observe import counter as _counter, histogram as _histogram
+    from ..observe import trace as _trace
 except ImportError:  # standalone copy: no package context
-    _counter = _histogram = None
+    _counter = _histogram = _trace = None
 
 
 class ServedModel:
@@ -119,9 +121,15 @@ class ServedModel:
                     f"feed {name!r}: shape {got} incompatible with {want}")
             args.append(a)
         t0 = time.perf_counter()
-        outs = self._exported.call(*self._weights, *args)
-        result = {n: np.asarray(v)
-                  for n, v in zip(self.fetch_names, outs)}
+        # per-request span: a serving process with tracing on gets one
+        # trace per inference call (root span unless the caller opened
+        # a request-level span around us)
+        infer_span = _trace.span("serve_infer") if _trace is not None \
+            else contextlib.nullcontext()
+        with infer_span:
+            outs = self._exported.call(*self._weights, *args)
+            result = {n: np.asarray(v)
+                      for n, v in zip(self.fetch_names, outs)}
         # np.asarray above synchronized the device, so this is true
         # end-to-end inference latency
         if _histogram is not None:

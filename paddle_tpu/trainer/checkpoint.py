@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from ..observe import counter, histogram
+from ..observe import counter, histogram, trace
 from ..utils import FLAGS, PaddleTpuError, get_logger
 
 log = get_logger("checkpoint")
@@ -64,35 +64,39 @@ def save_checkpoint(save_dir: str, pass_id: int, params: Dict[str, Any],
     final = os.path.join(save_dir, f"pass-{pass_id:05d}")
     os.makedirs(save_dir, exist_ok=True)
     t0 = time.perf_counter()
-    tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-ckpt-")
-    try:
-        np.savez(os.path.join(tmp, "params.npz"),
-                 **{k: np.asarray(v) for k, v in params.items()})
-        if buffers:
-            np.savez(os.path.join(tmp, "buffers.npz"),
-                     **{k: np.asarray(v) for k, v in buffers.items()})
-        manifest = {"pass_id": pass_id, "format": 2, **(meta or {})}
-        if opt_state is not None:
-            flat, treedef = _flatten_state(opt_state)
-            np.savez(os.path.join(tmp, "opt_state.npz"), **flat)
-            manifest["opt_treedef"] = str(treedef)
-        # digest every data file; the manifest is written LAST so its
-        # presence certifies the .npz files were fully flushed.  The
-        # --ckpt_verify kill switch disables the save-side hashing cost
-        # too (the dir then loads via the legacy structural check).
-        if FLAGS.ckpt_verify:
-            manifest["files"] = {
-                fname: {"sha256": _sha256_file(os.path.join(tmp, fname)),
-                        "bytes": os.path.getsize(os.path.join(tmp, fname))}
-                for fname in sorted(os.listdir(tmp))}
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=1)
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.replace(tmp, final)
-    except Exception:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
+    with trace.span("ckpt_save", pass_id=pass_id):
+        tmp = tempfile.mkdtemp(dir=save_dir, prefix=".tmp-ckpt-")
+        try:
+            np.savez(os.path.join(tmp, "params.npz"),
+                     **{k: np.asarray(v) for k, v in params.items()})
+            if buffers:
+                np.savez(os.path.join(tmp, "buffers.npz"),
+                         **{k: np.asarray(v) for k, v in buffers.items()})
+            manifest = {"pass_id": pass_id, "format": 2, **(meta or {})}
+            if opt_state is not None:
+                flat, treedef = _flatten_state(opt_state)
+                np.savez(os.path.join(tmp, "opt_state.npz"), **flat)
+                manifest["opt_treedef"] = str(treedef)
+            # digest every data file; the manifest is written LAST so
+            # its presence certifies the .npz files were fully flushed.
+            # The --ckpt_verify kill switch disables the save-side
+            # hashing cost too (the dir then loads via the legacy
+            # structural check).
+            if FLAGS.ckpt_verify:
+                manifest["files"] = {
+                    fname: {"sha256": _sha256_file(
+                                os.path.join(tmp, fname)),
+                            "bytes": os.path.getsize(
+                                os.path.join(tmp, fname))}
+                    for fname in sorted(os.listdir(tmp))}
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
     histogram("ckpt_save_seconds",
               "wall time of one atomic checkpoint save (serialize + "
               "digest + rename)").observe(time.perf_counter() - t0)
@@ -193,9 +197,11 @@ def verify_checkpoint(ckpt_dir: str) -> bool:
     manifest, or a bare params.npz from an external tool) degrade to a
     structural check: the archives must exist and open as valid zips.
     """
-    with histogram("ckpt_verify_seconds",
-                   "wall time of one checkpoint integrity verification "
-                   "(digest re-hash or structural check)").time():
+    with trace.span("ckpt_verify", dir=ckpt_dir), \
+            histogram("ckpt_verify_seconds",
+                      "wall time of one checkpoint integrity "
+                      "verification (digest re-hash or structural "
+                      "check)").time():
         return _verify_result(ckpt_dir) == "ok"
 
 
@@ -220,7 +226,8 @@ def quarantine_checkpoint(ckpt_dir: str) -> Optional[str]:
         n += 1
         target = os.path.join(parent, f".corrupt-{name}-{n}")
     try:
-        os.rename(ckpt_dir, target)
+        with trace.span("ckpt_quarantine", dir=ckpt_dir):
+            os.rename(ckpt_dir, target)
     except OSError as e:
         log.warning("could not quarantine %s (%s)", ckpt_dir, e)
         return None

@@ -32,6 +32,7 @@ from ..layers.network import NeuralNetwork
 from ..optimizer import Optimizer, create_optimizer, make_schedule
 from ..optimizer import loss_scale as ls
 from .. import observe
+from ..observe import trace
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger, global_stat
 from . import events as ev
 from .checkpoint import (
@@ -46,6 +47,10 @@ from .checkpoint import (
 )
 
 log = get_logger("trainer")
+
+# end-of-pass sentinel for the traced input wait (a StopIteration
+# escaping the span would stamp a false error on every pass's trace)
+_PASS_END = object()
 
 # Live trainers, for the conftest dtype-drift guard: after each precision
 # test it asserts no master parameter or optimizer-state leaf silently
@@ -428,6 +433,14 @@ class Trainer:
         al. host-vs-device split.  With no sink the fence is skipped:
         dispatch stays async and instrumentation is a few counter
         increments.
+
+        Tracing (``--trace_jsonl`` / ``--metrics_port``): the step runs
+        under a ``train_step`` span with ``feed`` / ``step_dispatch`` /
+        ``fence`` child phases; an explicitly opened trace
+        (``--trace_jsonl`` / ``trace.enable()``, NOT a lazy ``/trace``
+        scrape — see ``trace.fences_steps``) also fences the step so
+        the timeline shows true device time.  With tracing off every
+        span call is a shared no-op (<50 µs/step contract).
         """
         if self._train_step is None:
             self._train_step = self._build_train_step()
@@ -438,42 +451,9 @@ class Trainer:
             if self._ls_state is not None:
                 self._ls_state = self._replicate(
                     self._dealias(self._ls_state))
-        t0 = time.perf_counter()
-        if not placed:
-            feed = self._shard_feed(feed)
-        batch = _batch_size(feed)
-        rng = jax.random.PRNGKey(
-            (self.seed * 1000003 + self.samples_seen) % (2 ** 31))
-        t_feed = time.perf_counter()
-        with global_stat.timer("train_batch"):
-            progress = jnp.asarray(self.samples_seen, jnp.float32)
-            if self._ls_state is not None:
-                (self.params, self.opt_state, self.buffers, loss,
-                 self._ls_state) = self._train_step(
-                    self.params, self.opt_state, self.buffers, feed,
-                    rng, progress, self._ls_state)
-            else:
-                self.params, self.opt_state, self.buffers, loss = \
-                    self._train_step(self.params, self.opt_state,
-                                     self.buffers, feed, rng, progress)
-        self._count_recompiles()
-        t_dispatch = time.perf_counter()
-        if observe.active():
-            jax.block_until_ready(loss)
-            t_done = time.perf_counter()
-            self._sync_precision_metrics()   # fenced anyway: keep fresh
-            observe.histogram(
-                "train_device_blocked_seconds",
-                "time blocked on the device per step (fenced; only "
-                "recorded while a metrics sink is attached)"
-            ).observe(t_done - t_dispatch)
-            if t_done > t0:
-                observe.gauge(
-                    "train_samples_per_sec",
-                    "fenced per-step training throughput"
-                ).set(batch / (t_done - t0))
-        else:
-            t_done = t_dispatch
+        with trace.span("train_step", samples_seen=self.samples_seen):
+            t0, t_feed, t_done, batch, loss = \
+                self._traced_step_body(feed, placed)
         observe.histogram(
             "train_host_feed_seconds",
             "host time sharding/placing the feed per step"
@@ -486,6 +466,56 @@ class Trainer:
         observe.counter("train_samples", "samples trained").inc(batch)
         self.samples_seen += batch
         return loss  # device scalar: don't block — caller decides when
+
+    def _traced_step_body(self, feed: Dict[str, Any], placed: bool):
+        """The span-covered phases of one step: feed -> dispatch ->
+        fence.  Split out so the ``train_step`` span brackets exactly
+        this work (and restores its context even when a phase raises)."""
+        t0 = time.perf_counter()
+        with trace.span("feed", placed=placed):
+            if not placed:
+                feed = self._shard_feed(feed)
+            batch = _batch_size(feed)
+            rng = jax.random.PRNGKey(
+                (self.seed * 1000003 + self.samples_seen) % (2 ** 31))
+        t_feed = time.perf_counter()
+        with trace.span("step_dispatch"), global_stat.timer("train_batch"):
+            progress = jnp.asarray(self.samples_seen, jnp.float32)
+            if self._ls_state is not None:
+                (self.params, self.opt_state, self.buffers, loss,
+                 self._ls_state) = self._train_step(
+                    self.params, self.opt_state, self.buffers, feed,
+                    rng, progress, self._ls_state)
+            else:
+                self.params, self.opt_state, self.buffers, loss = \
+                    self._train_step(self.params, self.opt_state,
+                                     self.buffers, feed, rng, progress)
+        self._count_recompiles()
+        t_dispatch = time.perf_counter()
+        # fence when anyone is LISTENING: a metrics sink (the
+        # host/device split) or an explicitly-opened trace (a timeline
+        # whose step spans end at dispatch time would lie about where
+        # time went) — but NOT ring-only recording lazily enabled by a
+        # /trace scrape (trace.fences_steps): an endpoint probe must
+        # never convert async dispatch into a per-step device sync
+        if observe.active() or trace.fences_steps():
+            with trace.span("fence"):
+                jax.block_until_ready(loss)
+            t_done = time.perf_counter()
+            self._sync_precision_metrics()   # fenced anyway: keep fresh
+            observe.histogram(
+                "train_device_blocked_seconds",
+                "time blocked on the device per step (fenced; only "
+                "recorded while a metrics sink or trace is attached)"
+            ).observe(t_done - t_dispatch)
+            if t_done > t0:
+                observe.gauge(
+                    "train_samples_per_sec",
+                    "fenced per-step training throughput"
+                ).set(batch / (t_done - t0))
+        else:
+            t_done = t_dispatch
+        return t0, t_feed, t_done, batch, loss
 
     def _sync_precision_metrics(self) -> None:
         """Drain the device-side loss-scale state into observe: the
@@ -536,43 +566,52 @@ class Trainer:
             # keeps meaning "host input work the step had to wait for".
             wait_s = 0.0
             busy_s = 0.0
-            src, pipe = self._pipeline_or_sync(reader, feeder)
-            batches = iter(src)
-            try:
-                while True:
-                    t0 = time.perf_counter()
-                    try:
-                        batch = next(batches)
-                    except StopIteration:
-                        break
-                    dt = time.perf_counter() - t0
-                    wait_s += dt
-                    wait_hist.observe(dt)
-                    event_handler(ev.BeginIteration(pass_id, batch_id))
-                    t1 = time.perf_counter()
-                    if pipe is not None:      # converted+placed upstream
-                        feed = batch
-                    else:
-                        feed = feeder.convert(batch) if feeder else batch
-                    loss = self.train_one_batch(feed,
-                                                placed=pipe is not None)
-                    busy_s += time.perf_counter() - t1
-                    last_loss = loss
-                    if FLAGS.log_period and \
-                            (batch_id + 1) % FLAGS.log_period == 0:
-                        event_handler(ev.EndIteration(
-                            pass_id=pass_id, batch_id=batch_id,
-                            cost=float(loss)))
-                    if FLAGS.show_parameter_stats_period and \
-                            (batch_id + 1) % \
-                            FLAGS.show_parameter_stats_period == 0:
-                        from ..utils.profiler import parameter_stats
-                        log.info("parameter stats:\n%s",
-                                 parameter_stats(self.params))
-                    batch_id += 1
-            finally:
-                if pipe is not None:
-                    pipe.close()
+            # the pass span is the trace root of everything this pass
+            # does: step spans nest under it directly, and the async
+            # pipeline's worker threads (created inside it) adopt its
+            # context, so reader/convert/place and master-RPC spans all
+            # land in the same trace as the steps that consumed them
+            with trace.span("train_pass", pass_id=pass_id):
+                src, pipe = self._pipeline_or_sync(reader, feeder)
+                batches = iter(src)
+                try:
+                    while True:
+                        t0 = time.perf_counter()
+                        # sentinel instead of StopIteration so the last
+                        # (end-of-pass) wait isn't a false error span
+                        with trace.span("input_wait"):
+                            batch = next(batches, _PASS_END)
+                        if batch is _PASS_END:
+                            break
+                        dt = time.perf_counter() - t0
+                        wait_s += dt
+                        wait_hist.observe(dt)
+                        event_handler(ev.BeginIteration(pass_id, batch_id))
+                        t1 = time.perf_counter()
+                        if pipe is not None:  # converted+placed upstream
+                            feed = batch
+                        else:
+                            feed = feeder.convert(batch) if feeder \
+                                else batch
+                        loss = self.train_one_batch(
+                            feed, placed=pipe is not None)
+                        busy_s += time.perf_counter() - t1
+                        last_loss = loss
+                        if FLAGS.log_period and \
+                                (batch_id + 1) % FLAGS.log_period == 0:
+                            event_handler(ev.EndIteration(
+                                pass_id=pass_id, batch_id=batch_id,
+                                cost=float(loss)))
+                        if FLAGS.show_parameter_stats_period and \
+                                (batch_id + 1) % \
+                                FLAGS.show_parameter_stats_period == 0:
+                            from ..utils.profiler import parameter_stats
+                            log.info("parameter stats:\n%s",
+                                     parameter_stats(self.params))
+                        batch_id += 1
+                finally:
+                    if pipe is not None:
+                        pipe.close()
             self._sync_precision_metrics()   # pass boundary: one sync
             if wait_s + busy_s > 0:
                 observe.gauge(
@@ -609,50 +648,52 @@ class Trainer:
         eval_names = self._eval_output_names() if evaluators else []
         for e in evaluators:
             e.start()
-        src, pipe = self._pipeline_or_sync(reader, feeder)
-        try:
-            for batch in src:
-                if pipe is not None:        # converted+placed upstream
-                    feed = batch
-                else:
-                    feed = feeder.convert(batch) if feeder else batch
-                    feed = self._shard_feed(feed)
-                loss, outputs = self._eval_step(self.params, self.buffers,
-                                                feed)
-                b = _batch_size(feed)
-                total += float(loss) * b
-                n += b
-                if evaluators:
-                    # prefer the prediction layer over the cost output
-                    out0 = outputs.get(eval_names[0]) if eval_names \
-                        else None
-                    if out0 is None:
-                        out0 = next(iter(outputs.values()))
-                    for e in evaluators:
-                        entry = getattr(e, "_config_entry", None)
-                        if entry:
-                            ein = outputs.get(entry["input_layer_name"])
-                            if ein is None:
-                                log.warning(
-                                    "evaluator %s: input layer %r not in "
-                                    "eval outputs; skipping",
-                                    entry.get("name"),
-                                    entry["input_layer_name"])
-                                continue
-                            elab = feed.get(entry.get("label_layer_name",
-                                                      label_name))
-                            w = feed.get(entry["weight_layer_name"]) \
-                                if entry.get("weight_layer_name") else None
-                            if w is not None and "weight" in \
-                                    e.eval_batch.__code__.co_varnames:
-                                e.eval_batch(ein, elab, weight=w)
+        with trace.span("test_pass"):
+            src, pipe = self._pipeline_or_sync(reader, feeder)
+            try:
+                for batch in src:
+                    if pipe is not None:    # converted+placed upstream
+                        feed = batch
+                    else:
+                        feed = feeder.convert(batch) if feeder else batch
+                        feed = self._shard_feed(feed)
+                    loss, outputs = self._eval_step(self.params,
+                                                    self.buffers, feed)
+                    b = _batch_size(feed)
+                    total += float(loss) * b
+                    n += b
+                    if evaluators:
+                        # prefer the prediction layer over the cost output
+                        out0 = outputs.get(eval_names[0]) if eval_names \
+                            else None
+                        if out0 is None:
+                            out0 = next(iter(outputs.values()))
+                        for e in evaluators:
+                            entry = getattr(e, "_config_entry", None)
+                            if entry:
+                                ein = outputs.get(entry["input_layer_name"])
+                                if ein is None:
+                                    log.warning(
+                                        "evaluator %s: input layer %r not "
+                                        "in eval outputs; skipping",
+                                        entry.get("name"),
+                                        entry["input_layer_name"])
+                                    continue
+                                elab = feed.get(entry.get("label_layer_name",
+                                                          label_name))
+                                w = feed.get(entry["weight_layer_name"]) \
+                                    if entry.get("weight_layer_name") \
+                                    else None
+                                if w is not None and "weight" in \
+                                        e.eval_batch.__code__.co_varnames:
+                                    e.eval_batch(ein, elab, weight=w)
+                                else:
+                                    e.eval_batch(ein, elab)
                             else:
-                                e.eval_batch(ein, elab)
-                        else:
-                            e.eval_batch(out0, feed.get(label_name))
-        finally:
-            if pipe is not None:
-                pipe.close()
+                                e.eval_batch(out0, feed.get(label_name))
+            finally:
+                if pipe is not None:
+                    pipe.close()
         metrics = {"test_cost": total / max(n, 1)}
         for e in evaluators:
             vals = e.finish()

@@ -182,6 +182,32 @@ FLAGS.define("metrics_jsonl", "",
              "and the trainer skips its step-fencing time split")
 FLAGS.define("metrics_interval_s", 10.0,
              "flush interval for the --metrics_jsonl reporter")
+FLAGS.define("trace_jsonl", "",
+             "span-trace sink path (paddle_tpu/observe/trace.py): when "
+             "set, every span (trainer step phases, pipeline workers, "
+             "checkpoint ops, master RPCs incl. the server-side echo, "
+             "serving requests) streams to this file as Chrome "
+             "trace-event JSON — load it directly in Perfetto / "
+             "chrome://tracing; empty = no stream, span() is a shared "
+             "no-op and the hot path pays <50 us/step")
+FLAGS.define("trace_ring_size", 4096,
+             "flight-recorder capacity: the last N spans of a live run "
+             "kept in a bounded in-memory ring, served by the "
+             "--metrics_port /trace endpoint and the SIGUSR2 debug "
+             "dump")
+FLAGS.define("metrics_port", 0,
+             "live observability endpoint (paddle_tpu/observe/http.py):"
+             " serve GET /metrics (Prometheus text), /healthz "
+             "(liveness JSON) and /trace (flight-recorder dump as "
+             "Chrome trace-event JSON) on this loopback port; 0 (the "
+             "default) starts no server thread")
+FLAGS.define("debug_dump_signal", False,
+             "install a SIGUSR2 handler that dumps Prometheus text + "
+             "the flight-recorder trace of the LIVE run to timestamped "
+             "files under --debug_dump_dir (kill -USR2 <pid>) — "
+             "post-mortem for wedged runs without a debugger")
+FLAGS.define("debug_dump_dir", "/tmp",
+             "output directory for --debug_dump_signal dumps")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2,
              "async input pipeline depth (data/pipeline.py): max "

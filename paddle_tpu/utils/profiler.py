@@ -16,26 +16,66 @@ nvprof windows via ``hl_profiler_start/end``
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Iterator, Optional
 
 import jax
 
-from .logger import get_logger
+from .logger import get_logger, warn_once
 
 log = get_logger("profiler")
+
+# open-window bookkeeping: jax.profiler.start_trace is NOT re-entrant
+# (a nested start raises), so only the outermost trace() opens/closes
+# the window and inner uses are warn-once no-ops.  The depth doubles as
+# the "is an xprof window open" signal observe.trace keys on to wrap
+# spans in TraceAnnotations (host-span <-> XLA-op correlation).
+_depth_lock = threading.Lock()
+_trace_depth = 0
+
+
+def trace_active() -> bool:
+    """True while an xprof window opened by :func:`trace` is live."""
+    return _trace_depth > 0
 
 
 @contextlib.contextmanager
 def trace(logdir: str = "/tmp/paddle_tpu_trace") -> Iterator[None]:
     """``with profiler.trace(dir): ...`` — xprof window (nvprof-window
-    equivalent); view with TensorBoard's profile plugin."""
-    jax.profiler.start_trace(logdir)
-    log.info("profiler trace started → %s", logdir)
+    equivalent); view with TensorBoard's profile plugin.
+
+    Re-entrancy-safe: a nested ``trace`` (e.g. bench's ``--profile``
+    around a code path that opens its own window) warns once and rides
+    the already-open window instead of raising.  Windows are
+    tick-counted (``profiler_trace_windows_total``) so a run's artifact
+    records how many xprof dumps it produced."""
+    global _trace_depth
+    with _depth_lock:
+        nested = _trace_depth > 0
+        _trace_depth += 1
     try:
-        yield
+        if nested:
+            warn_once("profiler_trace_nested",
+                      "nested profiler.trace(%r): jax.profiler windows "
+                      "don't nest — riding the already-open window "
+                      "(reported once)", logdir, logger=log)
+            yield
+            return
+        from .. import observe
+
+        jax.profiler.start_trace(logdir)
+        observe.counter("profiler_trace_windows_total",
+                        "xprof/jax.profiler trace windows opened"
+                        ).inc()
+        log.info("profiler trace started → %s", logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", logdir)
     finally:
-        jax.profiler.stop_trace()
-        log.info("profiler trace written to %s", logdir)
+        with _depth_lock:
+            _trace_depth -= 1
 
 
 def annotate(name: str):
@@ -56,9 +96,13 @@ def parameter_stats(params) -> str:
     ``TrainerInternal.cpp:99-111``)."""
     import numpy as np
 
+    # ONE device_get over the whole dict: per-param serial gets pay a
+    # D2H round-trip each (hundreds of sync points on a big model);
+    # batching lets jax gather every leaf in a single transfer
+    values = jax.device_get(dict(params))
     rows = []
-    for name in sorted(params):
-        v = np.asarray(jax.device_get(params[name]))
+    for name in sorted(values):
+        v = np.asarray(values[name])
         rows.append(f"{name}: shape={tuple(v.shape)} "
                     f"absmax={np.abs(v).max():.4g} "
                     f"mean={v.mean():.4g} std={v.std():.4g}")

@@ -145,8 +145,12 @@ def rng():
 
 
 @pytest.fixture(autouse=True)
-def _reset_global_state():
+def _reset_global_state(_io_thread_leak_guard):
+    # depends on the thread-leak guard so THIS teardown (which stops the
+    # global trace writer / HTTP server threads) runs before the guard
+    # judges what's still alive
     yield
+    from paddle_tpu import observe
     from paddle_tpu.observe import REGISTRY
     from paddle_tpu.utils.logger import reset_warn_once
     from paddle_tpu.utils.stat import global_stat
@@ -154,15 +158,21 @@ def _reset_global_state():
     global_stat.reset()
     REGISTRY.reset()
     reset_warn_once()
+    # tracing + the HTTP endpoint are process-wide: a test that enabled
+    # them must not leak its recorder/server (threads) into the next
+    observe.trace.disable()
+    observe.http.stop_global()
 
 
-# Thread-leak guard: every pipeline/reader worker thread the framework
-# starts is named with the IO_THREAD_PREFIX ("ptpu-io-"); after each
-# test none may still be alive — a stray worker means a reader/pipeline
-# teardown path regressed (the exact class of bug the round-11 buffered/
-# xmap fixes close).  Default is a LOUD warning (a slow box can race a
-# join); set PADDLE_TPU_THREAD_GUARD_STRICT=1 to fail the test instead
-# — the same escalation contract as the fast-lane budget guard.
+# Thread-leak guard: every framework-owned service thread is named so
+# it can be audited — pipeline/reader workers ("ptpu-io-*"), the trace
+# JSONL writer ("ptpu-trace-writer", observe/trace.py) and the
+# observability HTTP server ("ptpu-metrics-http", observe/http.py).
+# After each test none may still be alive — a stray worker means a
+# teardown path regressed (the round-11 buffered/xmap bug class, or a
+# trace/endpoint left enabled).  Default is a LOUD warning (a slow box
+# can race a join); set PADDLE_TPU_THREAD_GUARD_STRICT=1 to fail the
+# test instead — the same escalation contract as the fast-lane guard.
 _THREAD_GUARD_GRACE_S = 2.0
 
 
@@ -172,10 +182,14 @@ def _io_thread_leak_guard(request):
     import warnings
 
     from paddle_tpu.data.pipeline import IO_THREAD_PREFIX
+    from paddle_tpu.observe.http import SERVER_THREAD_NAME
+    from paddle_tpu.observe.trace import WRITER_THREAD_NAME
+
+    prefixes = (IO_THREAD_PREFIX, WRITER_THREAD_NAME, SERVER_THREAD_NAME)
 
     def stray():
         return [t for t in threading.enumerate()
-                if t.is_alive() and t.name.startswith(IO_THREAD_PREFIX)]
+                if t.is_alive() and t.name.startswith(prefixes)]
 
     yield
     deadline = time.perf_counter() + _THREAD_GUARD_GRACE_S
