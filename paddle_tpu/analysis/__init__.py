@@ -1,0 +1,43 @@
+"""ptpu-lint: framework-invariant static analysis.
+
+The invariants the last rounds made load-bearing are enforced here
+instead of by convention:
+
+- **PT-TRACE**    trace purity: no host syncs / wall clocks / captured-
+                  container mutation inside functions reachable from a
+                  jitted step body (the round-12 ``buffers`` trap);
+- **PT-RECOMPILE** jit cache hazards: ``jax.jit`` in a loop, jit-and-
+                  call-in-one-expression, loop variables closed over by
+                  a jitted function, f-string cache keys;
+- **PT-RESOURCE** resource hygiene: manual ``__enter__``/``__exit__``,
+                  ``lock.acquire()`` outside ``with``/try-finally, bare
+                  or broad silent ``except: pass``, threads without the
+                  ``ptpu-`` name prefix the conftest leak guard keys on;
+- **PT-DTYPE**    precision-policy bypass: direct ``jnp.dot`` /
+                  ``jnp.einsum`` / ``lax.conv*`` outside ``ops/``;
+- **PT-LOCK**     deadlock analysis: the cross-module lock-acquisition
+                  graph derived from ``with lock:`` nesting must stay
+                  acyclic (plus the runtime checker in
+                  :mod:`paddle_tpu.analysis.lockorder`).
+
+Run it::
+
+    python -m paddle_tpu.analysis [paths] [--format text|json]
+                                  [--baseline FILE] [--lock-graph]
+
+Suppress a single deliberate finding with a justified pragma on the
+same line (or the line above)::
+
+    annot.__enter__()   # ptpu: lint-ok[PT-RESOURCE] guarded: see below
+
+This package is stdlib-only and never imports jax — the tier-1
+zero-findings test stays fast and the serving loader can't be dragged
+into a jax import by a lint run.
+
+This ``__init__`` is deliberately import-light: production modules
+import :mod:`paddle_tpu.analysis.lockorder` (the runtime lock-order
+checker's ``named_lock`` indirection) at interpreter startup, which
+must not pay for the analyzer's AST machinery.
+"""
+
+__all__ = ["engine", "lockorder"]

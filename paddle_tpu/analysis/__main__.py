@@ -1,0 +1,89 @@
+"""CLI: ``python -m paddle_tpu.analysis [paths] [options]``.
+
+Exit status: 0 = no non-suppressed findings, 1 = findings, 2 = usage
+error.  ``--baseline`` filters findings whose fingerprint is recorded
+(grandfathered debt); ``--write-baseline`` records the current
+findings as that debt.  ``--lock-graph`` prints the derived
+lock-acquisition hierarchy instead of linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import engine
+from .rules import lock_order
+
+
+def _default_paths() -> List[str]:
+    # the package this analyzer ships in: lint paddle_tpu/ itself
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="ptpu-lint: framework-invariant static analysis "
+                    "(PT-TRACE, PT-RECOMPILE, PT-RESOURCE, PT-DTYPE, "
+                    "PT-LOCK)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: the installed "
+                        "paddle_tpu package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule codes to run "
+                        f"(default: all of {', '.join(engine.RULE_CODES)})")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="JSON baseline: findings fingerprinted here are "
+                        "reported separately and do not fail the run")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write the current findings as a baseline and "
+                        "exit 0")
+    p.add_argument("--lock-graph", action="store_true",
+                   help="print the derived lock-acquisition graph / "
+                        "hierarchy (PT-LOCK's model) and exit")
+    args = p.parse_args(argv)
+
+    paths = args.paths or _default_paths()
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"ptpu-lint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    if args.lock_graph:
+        project, _ = engine.build_project(paths)
+        print(lock_order.render_graph(project))
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        or None
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = engine.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"ptpu-lint: cannot read baseline "
+                  f"{args.baseline}: {e}", file=sys.stderr)
+            return 2
+    try:
+        result = engine.run(paths, rules=rules, baseline=baseline)
+    except ValueError as e:         # unknown rule code
+        print(f"ptpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        engine.write_baseline(args.write_baseline, result)
+        print(f"ptpu-lint: wrote {len(result.findings) + len(result.baselined)} "
+              f"fingerprint(s) to {args.write_baseline}")
+        return 0
+
+    out = result.to_json() if args.format == "json" else result.to_text()
+    print(out)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,364 @@
+"""Project index + best-effort call resolution for the lint rules.
+
+One parse of every analyzed file feeds all five rules.  Resolution is
+deliberately conservative: a call is resolved only when the target is
+statically unambiguous (same-module function, ``self.method`` on the
+enclosing class, a ``from x import f`` / ``import x as m; m.f()``
+target inside the analyzed set, or a name bound to ``ClassName(...)``
+in the same module).  Everything else is *unresolved* and simply does
+not contribute edges — under-approximating the call graph keeps
+PT-TRACE reachability and PT-LOCK edges free of false positives, at
+the cost of not seeing through duck-typed attribute calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ------------------------------------------------------------------ data
+
+
+class FunctionInfo:
+    __slots__ = ("node", "module", "qualname", "class_name", "parent",
+                 "params", "locals")
+
+    def __init__(self, node: ast.AST, module: "ModuleInfo", qualname: str,
+                 class_name: Optional[str], parent: Optional[str]):
+        self.node = node
+        self.module = module
+        self.qualname = qualname
+        self.class_name = class_name
+        self.parent = parent        # enclosing function qualname (or None)
+        self.params: Set[str] = set()
+        self.locals: Set[str] = set()
+        args = node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            self.params.add(a.arg)
+        if args.vararg:
+            self.params.add(args.vararg.arg)
+        if args.kwarg:
+            self.params.add(args.kwarg.arg)
+
+    def __repr__(self) -> str:
+        return f"<fn {self.module.name}:{self.qualname}>"
+
+
+def _local_names(node: ast.AST) -> Set[str]:
+    """Names bound by assignment/for/with/comprehension DIRECTLY in this
+    function (nested function bodies excluded)."""
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, n):   # don't descend into nested defs
+            out.add(n.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, n):
+            out.add(n.name)
+
+        def visit_Name(self, n):
+            if isinstance(n.ctx, (ast.Store, ast.Del)):
+                out.add(n.id)
+
+        def visit_Import(self, n):
+            for al in n.names:
+                out.add((al.asname or al.name).split(".")[0])
+
+        def visit_ImportFrom(self, n):
+            for al in n.names:
+                out.add(al.asname or al.name)
+
+    v = V()
+    for child in ast.iter_child_nodes(node):
+        v.visit(child)
+    return out
+
+
+class ModuleInfo:
+    def __init__(self, path: str, name: str, tree: ast.Module, source: str,
+                 is_package: bool = False):
+        self.path = path
+        self.name = name            # dotted, e.g. paddle_tpu.data.pipeline
+        self.is_package = is_package   # an __init__.py (name = the package)
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        self.imports: Dict[str, str] = {}        # alias -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # n -> (mod, orig)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, List[str]] = {}  # class -> method names
+        self.str_constants: Dict[str, str] = {}  # NAME -> literal value
+        self.instance_of: Dict[str, str] = {}    # var -> class qualname
+
+    def short(self) -> str:
+        n = self.name
+        return n[len("paddle_tpu."):] if n.startswith("paddle_tpu.") else n
+
+
+# ----------------------------------------------------------------- index
+
+
+def _module_name_for(path: str) -> Tuple[str, bool]:
+    """(dotted module name, is_package) from a file path: the file's
+    stem prefixed with every ancestor directory that is itself a
+    package (has an ``__init__.py``) — i.e. the name Python would
+    import it under from the package root.  Two same-named files in
+    different packages get distinct names instead of colliding."""
+    path = os.path.normpath(os.path.abspath(path))
+    stem = os.path.basename(path)
+    if stem.endswith(".py"):
+        stem = stem[:-3]
+    parts = [stem]
+    d = os.path.dirname(path)
+    while d and os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    is_package = parts[-1] == "__init__"
+    if is_package:
+        parts = parts[:-1]
+    return ".".join(parts) or "<module>", is_package
+
+
+def _resolve_relative(base: str, is_package: bool, level: int,
+                      module: Optional[str]) -> str:
+    """``from ..x import y`` inside ``base`` → dotted absolute module.
+
+    For a plain module ``a.b.c``, level 1 is its package ``a.b``; for a
+    package ``__init__`` (base IS the package ``a.b``), level 1 is
+    ``a.b`` itself — a package's name already ends at its own level.
+    """
+    pkg = base.split(".")
+    if not is_package:
+        pkg = pkg[:-1]
+    up = level - 1
+    pkg = pkg[: len(pkg) - up] if up <= len(pkg) else []
+    if module:
+        pkg = pkg + module.split(".")
+    return ".".join(pkg)
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.scope: List[str] = []          # qualname parts
+        self.class_stack: List[str] = []
+        self.func_stack: List[str] = []     # enclosing function qualnames
+
+    # ---- imports (collected wherever they appear, incl. inside funcs)
+    def visit_Import(self, node: ast.Import) -> None:
+        for al in node.names:
+            if al.asname:               # import a.b as m -> m: a.b
+                self.mod.imports[al.asname] = al.name
+            else:                       # import a.b -> binds a
+                root = al.name.split(".")[0]
+                self.mod.imports[root] = root
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        src = _resolve_relative(self.mod.name, self.mod.is_package,
+                                node.level, node.module) \
+            if node.level else (node.module or "")
+        for al in node.names:
+            self.mod.from_imports[al.asname or al.name] = (src, al.name)
+
+    # ---- defs
+    def _enter_def(self, node) -> None:
+        qual = ".".join(self.scope + [node.name])
+        cls = self.class_stack[-1] if self.class_stack else None
+        parent = self.func_stack[-1] if self.func_stack else None
+        info = FunctionInfo(node, self.mod, qual, cls, parent)
+        info.locals = _local_names(node)
+        self.mod.functions[qual] = info
+        if cls is not None and not self.func_stack:
+            self.mod.classes.setdefault(cls, []).append(node.name)
+        self.scope.append(node.name)
+        self.func_stack.append(qual)
+        self.generic_visit(node)
+        self.func_stack.pop()
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_def(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._enter_def(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.mod.classes.setdefault(node.name, [])
+        self.scope.append(node.name)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+        self.scope.pop()
+
+    # ---- module-level simple facts
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.func_stack and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.mod.str_constants[tgt] = node.value.value
+            elif isinstance(node.value, ast.Call):
+                cls = dotted_name(node.value.func)
+                if cls:
+                    self.mod.instance_of[tgt] = cls
+        self.generic_visit(node)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` attribute chain → "a.b.c" (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Project:
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}       # dotted name -> info
+        self.by_path: Dict[str, ModuleInfo] = {}
+
+    # ------------------------------------------------------------ loading
+    def add_file(self, path: str) -> Optional[ModuleInfo]:
+        path = os.path.abspath(path)
+        if path in self.by_path:
+            return self.by_path[path]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, ValueError):
+            return None
+        name, is_package = _module_name_for(path)
+        mod = ModuleInfo(path, name, tree, source, is_package)
+        _Indexer(mod).visit(tree)
+        # first registration wins the NAME (the import-resolution key);
+        # the file is analyzed either way — rules iterate by path
+        self.modules.setdefault(mod.name, mod)
+        self.by_path[path] = mod
+        return mod
+
+    def iter_modules(self):
+        """Every parsed file, exactly once — rule loops use this, not
+        ``modules.values()``, so a module-name collision can never
+        silently drop a file from analysis."""
+        return self.by_path.values()
+
+    # --------------------------------------------------------- resolution
+    def module_for(self, dotted: str) -> Optional[ModuleInfo]:
+        return self.modules.get(dotted)
+
+    def _function_in(self, dotted_mod: str, name: str) \
+            -> Optional[FunctionInfo]:
+        mod = self.modules.get(dotted_mod)
+        if mod is None:
+            return None
+        fn = mod.functions.get(name)
+        if fn is not None:
+            return fn
+        # re-export through a package __init__: follow one from-import hop
+        tgt = mod.from_imports.get(name)
+        if tgt is not None and tgt[0] in self.modules:
+            return self.modules[tgt[0]].functions.get(tgt[1])
+        return None
+
+    def resolve_name(self, mod: ModuleInfo, fn: Optional[FunctionInfo],
+                     name: str) -> Optional[FunctionInfo]:
+        """A bare ``Name`` in call position → FunctionInfo (or None)."""
+        # innermost nested def first: f.qualname + "." + name, walking up
+        cur = fn
+        while cur is not None:
+            cand = mod.functions.get(cur.qualname + "." + name)
+            if cand is not None:
+                return cand
+            cur = mod.functions.get(cur.parent) if cur.parent else None
+        cand = mod.functions.get(name)
+        if cand is not None:
+            return cand
+        tgt = mod.from_imports.get(name)
+        if tgt is not None:
+            return self._function_in(tgt[0], tgt[1])
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, fn: Optional[FunctionInfo],
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_name(mod, fn, func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            attr = func.attr
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fn is not None and fn.class_name:
+                    return mod.functions.get(fn.class_name + "." + attr)
+                # import x as m; m.f()
+                if base.id in mod.imports:
+                    return self._function_in(mod.imports[base.id], attr)
+                # from . import observe; observe.f()
+                tgt = mod.from_imports.get(base.id)
+                if tgt is not None:
+                    dotted = (tgt[0] + "." + tgt[1]) if tgt[0] else tgt[1]
+                    got = self._function_in(dotted, attr)
+                    if got is not None:
+                        return got
+                # _global = ClassName(...); _global.f()
+                cls = mod.instance_of.get(base.id)
+                if cls is not None and "." not in cls:
+                    return mod.functions.get(cls + "." + attr)
+            # a.b.c.f(): resolve the chain as a module path
+            chain = dotted_name(func)
+            if chain:
+                parts = chain.split(".")
+                root = parts[0]
+                if root in mod.imports:
+                    parts = mod.imports[root].split(".") + parts[1:]
+                elif root in mod.from_imports:
+                    src, orig = mod.from_imports[root]
+                    parts = (src.split(".") if src else []) + [orig] \
+                        + parts[1:]
+                for cut in range(len(parts) - 1, 0, -1):
+                    m2 = ".".join(parts[:cut])
+                    if m2 in self.modules:
+                        return self._function_in(m2, ".".join(parts[cut:]))
+        return None
+
+    # ---------------------------------------------------- name → module ref
+    def names_module(self, mod: ModuleInfo, name: str,
+                     target: str) -> bool:
+        """Does ``name`` in ``mod`` refer to (a submodule of) the
+        external module ``target`` (e.g. "numpy", "time", "jax")?"""
+        dotted = mod.imports.get(name)
+        if dotted is not None:
+            return dotted == target or dotted.startswith(target + ".")
+        fi = mod.from_imports.get(name)
+        if fi is not None:
+            full = (fi[0] + "." + fi[1]) if fi[0] else fi[1]
+            return full == target or full.startswith(target + ".")
+        return False
+
+
+def iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def own_statements(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested defs/lambdas
+    (their bodies are separate functions with their own reachability)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

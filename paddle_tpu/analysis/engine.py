@@ -1,0 +1,216 @@
+"""Lint engine: file walking, pragma suppression, baselines, reporting.
+
+The engine owns everything rule-agnostic: it parses every target file
+once into a shared :class:`~paddle_tpu.analysis.callgraph.Project`,
+runs each registered rule over it, filters findings through the
+``# ptpu: lint-ok[RULE]`` pragmas and an optional baseline file, and
+renders text/JSON reports.  Rules never read files or comments — they
+see ASTs and emit :class:`Finding`s; suppression is centralized here so
+every rule gets identical pragma semantics for free.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import ModuleInfo, Project
+
+#: Every rule family, in report order.
+RULE_CODES = ("PT-TRACE", "PT-RECOMPILE", "PT-RESOURCE", "PT-DTYPE",
+              "PT-LOCK")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*ptpu:\s*lint-ok\[([A-Za-z0-9_, \-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # absolute
+    line: int
+    col: int
+    message: str
+
+    def relpath(self, root: Optional[str] = None) -> str:
+        base = root or os.getcwd()
+        try:
+            rel = os.path.relpath(self.path, base)
+        except ValueError:          # different drive (windows)
+            return self.path
+        return self.path if rel.startswith("..") else rel
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity, so a baseline survives unrelated
+        edits above the finding.  Keyed on the cwd-relative path (write
+        and consume baselines from the same directory, i.e. the repo
+        root) — a bare basename would let a baselined finding in one
+        ``__init__.py`` mask a brand-new identical one in another."""
+        raw = f"{self.rule}|{self.relpath()}|{self.message}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def render(self, root: Optional[str] = None) -> str:
+        return (f"{self.relpath(root)}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+def _pragmas(source: str) -> Dict[int, Set[str]]:
+    """line number → set of rule codes suppressed on that line."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",")
+                         if c.strip()}
+                out.setdefault(tok.start[0], set()).update(codes)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _is_suppressed(f: Finding, pragmas: Dict[int, Set[str]],
+                   lines: Sequence[str]) -> bool:
+    for ln in (f.line, f.line - 1):
+        codes = pragmas.get(ln)
+        if not codes:
+            continue
+        if f.rule in codes or "ALL" in codes:
+            if ln == f.line:
+                return True
+            # the line above only suppresses when it is a comment-only
+            # line (a trailing pragma governs its own line, not the next)
+            text = lines[ln - 1].strip() if 0 < ln <= len(lines) else ""
+            if text.startswith("#"):
+                return True
+    return False
+
+
+@dataclasses.dataclass
+class Result:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    files: int
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def to_json(self, root: Optional[str] = None) -> str:
+        def row(f: Finding) -> Dict[str, object]:
+            return {"rule": f.rule, "path": f.relpath(root),
+                    "line": f.line, "col": f.col, "message": f.message,
+                    "fingerprint": f.fingerprint}
+
+        return json.dumps({
+            "files": self.files,
+            "findings": [row(f) for f in self.findings],
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+        }, indent=2)
+
+    def to_text(self, root: Optional[str] = None) -> str:
+        lines = [f.render(root) for f in self.findings]
+        lines.append(
+            f"ptpu-lint: {len(self.findings)} finding(s) in "
+            f"{self.files} file(s) "
+            f"({len(self.suppressed)} suppressed by pragma, "
+            f"{len(self.baselined)} baselined)")
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- walk
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/dirs into a sorted list of .py files (pycache and
+    hidden dirs skipped)."""
+    out: Set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.add(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return set(data.get("fingerprints", []))
+    return set(data)
+
+
+def write_baseline(path: str, result: Result) -> None:
+    fps = sorted({f.fingerprint for f in result.findings}
+                 | {f.fingerprint for f in result.baselined})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"fingerprints": fps}, f, indent=2)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ run
+def build_project(paths: Sequence[str]) -> Tuple[Project, List[str]]:
+    project = Project()
+    files = collect_files(paths)
+    loaded = []
+    for path in files:
+        if project.add_file(path) is not None:
+            loaded.append(path)
+    return project, loaded
+
+
+def run(paths: Sequence[str],
+        rules: Optional[Sequence[str]] = None,
+        baseline: Optional[Set[str]] = None) -> Result:
+    """Analyze ``paths`` with the selected rule families (default all)."""
+    from .rules import ALL_RULES
+
+    project, files = build_project(paths)
+    selected = list(rules) if rules else list(RULE_CODES)
+    unknown = [r for r in selected if r not in ALL_RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s) {unknown!r}; "
+                         f"choose from {sorted(ALL_RULES)}")
+
+    raw: List[Finding] = []
+    for code in selected:
+        raw.extend(ALL_RULES[code](project))
+
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    pragma_cache: Dict[str, Dict[int, Set[str]]] = {}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        mod = project.by_path.get(f.path)
+        if mod is None:                      # pragma: no cover — defensive
+            kept.append(f)
+            continue
+        if f.path not in pragma_cache:      # setdefault would tokenize
+            pragma_cache[f.path] = _pragmas(mod.source)   # per finding
+        pragmas = pragma_cache[f.path]
+        if _is_suppressed(f, pragmas, mod.lines):
+            suppressed.append(f)
+        elif baseline and f.fingerprint in baseline:
+            baselined.append(f)
+        else:
+            kept.append(f)
+    return Result(kept, suppressed, baselined, len(files))

@@ -1,0 +1,19 @@
+"""Rule registry: code → ``run(project) -> [Finding]``."""
+
+from __future__ import annotations
+
+from .dtype_policy import run as _dtype
+from .lock_order import run as _lock
+from .recompile import run as _recompile
+from .resource import run as _resource
+from .trace_purity import run as _trace
+
+ALL_RULES = {
+    "PT-TRACE": _trace,
+    "PT-RECOMPILE": _recompile,
+    "PT-RESOURCE": _resource,
+    "PT-DTYPE": _dtype,
+    "PT-LOCK": _lock,
+}
+
+__all__ = ["ALL_RULES"]

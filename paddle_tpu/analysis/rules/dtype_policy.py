@@ -1,0 +1,73 @@
+"""PT-DTYPE — precision-policy bypass.
+
+Every MXU-shaped op (matmul/einsum/conv) must route through
+``paddle_tpu/ops/`` so the ``core/dtypes.py`` policy decides its
+compute/accumulate dtypes and ``precision_dispatch_total`` sees it.
+A direct ``jnp.dot`` / ``jnp.matmul`` / ``jnp.einsum`` /
+``lax.conv*`` / ``lax.dot_general`` call anywhere else silently pins
+fp32 (or whatever the operand dtypes happen to be), exactly the bug
+class round 12 fixed in the attention projections.  Deliberate
+bypasses (fp32-by-design numerics) carry a justified pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..callgraph import Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-DTYPE"
+
+_JNP_OPS = {"dot", "matmul", "einsum", "tensordot", "vdot", "inner"}
+_LAX_PREFIXES = ("conv",)
+_LAX_OPS = {"dot_general", "dot"}
+
+#: modules whose JOB is dtype dispatch (the policy lives there) — keyed
+#: on the dotted module name, NOT the filesystem path: a checkout under
+#: e.g. /home/ci/core/ must not exempt the whole repo
+_EXEMPT_PREFIXES = ("paddle_tpu.ops", "paddle_tpu.core")
+
+
+def _is_exempt(mod) -> bool:
+    return any(mod.name == p or mod.name.startswith(p + ".")
+               for p in _EXEMPT_PREFIXES)
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        if _is_exempt(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            if chain is None or "." not in chain:
+                continue
+            parts = chain.split(".")
+            root, attr = parts[0], parts[-1]
+            # `import jax; jax.numpy.dot(...)` / `jax.lax.dot_general`
+            # spell the submodule through the jax root
+            via_jax = (len(parts) == 3
+                       and project.names_module(mod, root, "jax"))
+            is_jnp = project.names_module(mod, root, "jax.numpy") or (
+                via_jax and parts[1] == "numpy")
+            is_lax = project.names_module(mod, root, "jax.lax") or (
+                via_jax and parts[1] == "lax")
+            if is_jnp and attr in _JNP_OPS:
+                op = f"jnp.{attr}"
+            elif is_lax and (attr in _LAX_OPS
+                             or attr.startswith(_LAX_PREFIXES)):
+                op = f"lax.{attr}"
+            else:
+                continue
+            out.append(Finding(
+                RULE, mod.path, node.lineno, node.col_offset,
+                f"direct {op} outside ops/ bypasses the precision "
+                "policy (core/dtypes.py) and the "
+                "precision_dispatch_total census — route through "
+                "paddle_tpu.ops (e.g. math_ops.matmul/einsum) or "
+                "pragma a deliberate fp32-by-design site"))
+    return out

@@ -1,0 +1,136 @@
+"""PT-RECOMPILE — jit cache hazards.
+
+``jax.jit`` keys its cache on the function object plus argument
+shapes/dtypes.  Three shapes of code defeat that cache and silently
+recompile on a hot path:
+
+- **jit-in-loop**: ``jax.jit(...)`` inside a ``for``/``while`` body
+  builds a fresh jitted callable (fresh cache) every iteration;
+- **jit-and-call**: ``jax.jit(f)(x)`` in one expression builds and
+  discards the callable — every execution of the statement retraces;
+- **loop-var closure**: a function defined in a loop and jitted closes
+  over the loop variable; each iteration bakes a different constant
+  into an otherwise identical trace (the "Python scalars closed over
+  instead of passed" trap — pass them as arguments or mark them
+  static);
+- **f-string cache key**: caching compiled artifacts under an f-string
+  key interpolating runtime objects (reprs are not stable identities —
+  two equal shapes can render differently, two different dtypes can
+  render the same).  Flagged when the subscripted/``.get``-ed mapping
+  name contains "cache".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..callgraph import ModuleInfo, Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-RECOMPILE"
+
+
+def _is_jit_call(project: Project, mod: ModuleInfo,
+                 call: ast.Call) -> bool:
+    chain = dotted_name(call.func)
+    if chain is None:
+        return False
+    parts = chain.split(".")
+    if parts[-1] != "jit":
+        return False
+    if len(parts) == 1:
+        return mod.from_imports.get("jit", ("", ""))[0] == "jax"
+    return project.names_module(mod, parts[0], "jax")
+
+
+def _loop_vars(loop: ast.AST) -> set:
+    out = set()
+    if isinstance(loop, ast.For):
+        for n in ast.walk(loop.target):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        loop_stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Call) \
+                    and _is_jit_call(project, mod, node):
+                if loop_stack:
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno, node.col_offset,
+                        "jax.jit called inside a loop — a fresh jitted "
+                        "callable (and cache) per iteration; hoist the "
+                        "jit out of the loop"))
+                    # loop-variable closure through the jitted function
+                    lv = set()
+                    for lp in loop_stack:
+                        lv |= _loop_vars(lp)
+                    arg = node.args[0] if node.args else None
+                    if isinstance(arg, ast.Lambda) and lv:
+                        free = {n.id for n in ast.walk(arg.body)
+                                if isinstance(n, ast.Name)}
+                        captured = sorted(free & lv)
+                        if captured:
+                            out.append(Finding(
+                                RULE, mod.path, arg.lineno,
+                                arg.col_offset,
+                                f"jitted lambda closes over loop "
+                                f"variable(s) {captured} — each "
+                                "iteration bakes a new constant and "
+                                "retraces; pass them as arguments"))
+            # jit-and-call in one expression: jax.jit(f)(x)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Call) \
+                    and _is_jit_call(project, mod, node.func):
+                out.append(Finding(
+                    RULE, mod.path, node.lineno, node.col_offset,
+                    "jax.jit(f)(...) builds and discards the jitted "
+                    "callable — every execution retraces; bind "
+                    "`g = jax.jit(f)` once and call g"))
+            # f-string cache keys
+            key: Optional[ast.AST] = None
+            target: Optional[str] = None
+            if isinstance(node, ast.Subscript) \
+                    and isinstance(node.slice, ast.JoinedStr):
+                key, target = node.slice, dotted_name(node.value)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("get", "setdefault") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.JoinedStr):
+                key, target = node.args[0], dotted_name(node.func.value)
+            if key is not None and target is not None \
+                    and "cache" in target.lower():
+                out.append(Finding(
+                    RULE, mod.path, key.lineno, key.col_offset,
+                    f"f-string used as a cache key on {target!r} — "
+                    "reprs are not stable shape/dtype identities; key "
+                    "on a tuple of (shape, dtype, flags) instead"))
+
+            if isinstance(node, (ast.For, ast.While)):
+                loop_stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                loop_stack.pop()
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                # a def inside a loop runs once per iteration, but the
+                # jit hazard is about CALL frequency, which the
+                # jit-in-loop check above already covers at the jit
+                # site; don't carry the loop context into the body
+                saved, loop_stack[:] = list(loop_stack), []
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                loop_stack[:] = saved
+            else:
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+        visit(mod.tree)
+    return out

@@ -1,0 +1,249 @@
+"""PT-RESOURCE — resource hygiene.
+
+Four checks, all born from real rounds of review pain:
+
+- **manual-ctx**: a call to ``x.__enter__()`` / ``x.__exit__(...)``
+  outside a class's own ``__enter__``/``__exit__`` definition.  Round
+  13's review pass rewrote every such site after a fault between
+  ``__enter__`` and the ``try`` leaked the thread-local trace context
+  for the thread's lifetime — ``with`` blocks are the only shape that
+  cannot leak.
+- **bare-acquire**: ``lock.acquire()`` on a lock-ish name (``*lock*``,
+  ``*cond*``, ``*mutex*``) that is neither ``with``-scoped nor
+  immediately guarded by ``try/finally: release`` — an exception
+  between acquire and release deadlocks every later acquirer.
+- **silent-except**: a bare ``except:`` with any body, or a broad
+  ``except Exception/BaseException:`` whose body is ONLY ``pass`` —
+  the failure class that hid the round-9 abandoned-lease bug.  Narrow
+  handlers (``except OSError: pass``) are allowed; broad ones must at
+  least log.
+- **thread-name**: ``threading.Thread(...)`` without a ``name=`` that
+  statically starts with ``ptpu-`` — the conftest thread-leak guard
+  audits framework threads BY prefix, so an unprefixed thread is
+  invisible to it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..callgraph import ModuleInfo, Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-RESOURCE"
+_LOCKISH = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+THREAD_PREFIX = "ptpu-"
+
+
+def _find(mod: ModuleInfo, node: ast.AST, msg: str) -> Finding:
+    return Finding(RULE, mod.path, node.lineno, node.col_offset, msg)
+
+
+# ------------------------------------------------------------ manual ctx
+def _enclosing_dunder_ok(stack: List[ast.AST]) -> bool:
+    """Inside a def named __enter__/__exit__ (a context manager that
+    delegates to another is legitimate)."""
+    for n in reversed(stack):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return n.name in ("__enter__", "__exit__")
+    return False
+
+
+# --------------------------------------------------------- bare acquire
+def _acquire_target(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "acquire":
+        name = dotted_name(f.value)
+        if name is None and isinstance(f.value, ast.Attribute):
+            name = f.value.attr
+        if name and _LOCKISH.search(name):
+            return name
+    return None
+
+
+def _release_in(node: ast.AST, target: str) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "release":
+            name = dotted_name(n.func.value) or \
+                (n.func.value.attr
+                 if isinstance(n.func.value, ast.Attribute) else None)
+            if name == target:
+                return True
+    return False
+
+
+def _guarded_by_try_finally(stmts: list, idx: int, target: str) -> bool:
+    """acquire at stmts[idx] is OK when the NEXT statement is a
+    ``try/finally`` whose finally releases the same lock (the classic
+    pre-with idiom)."""
+    if idx + 1 < len(stmts):
+        nxt = stmts[idx + 1]
+        if isinstance(nxt, ast.Try) and nxt.finalbody \
+                and any(_release_in(s, target) for s in nxt.finalbody):
+            return True
+    return False
+
+
+# ------------------------------------------------------------ except/pass
+def _body_is_pass(body: list) -> bool:
+    return all(isinstance(s, ast.Pass) for s in body)
+
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted_name(e) or "" for e in t.elts]
+    else:
+        names = [dotted_name(t) or ""]
+    return any(n.split(".")[-1] in _BROAD for n in names)
+
+
+# ------------------------------------------------------------ thread name
+def _static_name_prefix(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
+    """Best-effort static prefix of a thread-name expression; None when
+    unresolvable (unresolvable names are not flagged)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return mod.str_constants.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _static_name_prefix(mod, node.left)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant):
+            return str(first.value)
+        if isinstance(first, ast.FormattedValue):
+            return _static_name_prefix(mod, first.value)
+    return None
+
+
+def _imported_constant(project: Project, mod: ModuleInfo,
+                       name: str) -> Optional[str]:
+    tgt = mod.from_imports.get(name)
+    if tgt is None:
+        return None
+    src = project.module_for(tgt[0])
+    return src.str_constants.get(tgt[1]) if src is not None else None
+
+
+def _thread_name_finding(project: Project, mod: ModuleInfo,
+                         call: ast.Call) -> Optional[str]:
+    chain = dotted_name(call.func)
+    if chain is None or chain.split(".")[-1] != "Thread":
+        return None
+    root = chain.split(".")[0]
+    if root != "Thread" and not project.names_module(
+            mod, root, "threading"):
+        return None
+    if root == "Thread" and mod.from_imports.get(
+            "Thread", ("", ""))[0] != "threading":
+        return None
+    name_kw = next((kw.value for kw in call.keywords
+                    if kw.arg == "name"), None)
+    if name_kw is None:
+        return ("threading.Thread without a name= — framework threads "
+                f"must carry the {THREAD_PREFIX!r} prefix so the "
+                "conftest leak guard can audit them")
+    prefix = _static_name_prefix(mod, name_kw)
+    if prefix is None and isinstance(name_kw, ast.Name):
+        prefix = _imported_constant(project, mod, name_kw.id)
+    if prefix is None and isinstance(name_kw, ast.BinOp) \
+            and isinstance(name_kw.op, ast.Add) \
+            and isinstance(name_kw.left, ast.Name):
+        prefix = _imported_constant(project, mod, name_kw.left.id)
+    if prefix is None and isinstance(name_kw, ast.JoinedStr) \
+            and name_kw.values \
+            and isinstance(name_kw.values[0], ast.FormattedValue) \
+            and isinstance(name_kw.values[0].value, ast.Name):
+        prefix = _imported_constant(project, mod,
+                                    name_kw.values[0].value.id)
+    if prefix is not None and not prefix.startswith(THREAD_PREFIX):
+        return (f"thread name {prefix!r} lacks the {THREAD_PREFIX!r} "
+                "prefix the conftest thread-leak guard keys on")
+    return None
+
+
+# -------------------------------------------------------------- the rule
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            # manual __enter__/__exit__
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("__enter__", "__exit__") \
+                    and not _enclosing_dunder_ok(stack):
+                out.append(_find(
+                    mod, node,
+                    f"manual {node.func.attr}() call — use a `with` "
+                    "block (a fault between enter and try leaks the "
+                    "resource; round-13 trace-context bug class)"))
+            # bare acquire
+            if isinstance(node, ast.Call):
+                tgt = _acquire_target(node)
+                if tgt is not None:
+                    parent = stack[-1] if stack else None
+                    ok = False
+                    # with lock.acquire()? nonsense — only Expr stmts
+                    # followed by try/finally or inside one count
+                    for holder in reversed(stack):
+                        found = False
+                        for fieldname in ("body", "orelse", "finalbody"):
+                            body = getattr(holder, fieldname, None)
+                            if not isinstance(body, list):
+                                continue
+                            for i, s in enumerate(body):
+                                if s is parent or s is node or (
+                                        isinstance(s, ast.Expr)
+                                        and s.value is node):
+                                    ok = _guarded_by_try_finally(
+                                        body, i, tgt)
+                                    found = True
+                                    break
+                            if found:
+                                break
+                        if found:
+                            break
+                    if not ok:
+                        out.append(_find(
+                            mod, node,
+                            f"{tgt}.acquire() outside `with`/"
+                            "try-finally — an exception before "
+                            "release() deadlocks every later "
+                            "acquirer"))
+            # silent except
+            if isinstance(node, ast.ExceptHandler):
+                if node.type is None:
+                    out.append(_find(
+                        mod, node,
+                        "bare `except:` — catches SystemExit/"
+                        "KeyboardInterrupt too; name the exceptions"))
+                elif _is_broad(node) and _body_is_pass(node.body):
+                    out.append(_find(
+                        mod, node,
+                        "broad silent `except "
+                        f"{ast.unparse(node.type) if node.type else ''}"
+                        ": pass` — swallow narrowly or at least log"))
+            # thread names
+            if isinstance(node, ast.Call):
+                msg = _thread_name_finding(project, mod, node)
+                if msg is not None:
+                    out.append(_find(mod, node, msg))
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(mod.tree)
+    return out

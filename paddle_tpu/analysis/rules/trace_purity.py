@@ -1,0 +1,214 @@
+"""PT-TRACE — trace purity of jitted step bodies.
+
+A function traced by ``jax.jit`` runs ONCE per (shape, dtype) bucket;
+anything impure inside it either crashes at trace time
+(``UnexpectedTracerError`` — the round-12 ``buffers`` trap), silently
+bakes a trace-time value into the compiled program (``time.time()``,
+``float(x)``), or forces a host round-trip per call
+(``block_until_ready`` / ``device_get`` / ``.item()`` /
+``np.asarray``).  This rule derives the set of functions statically
+reachable from jit roots (functions passed to ``jax.jit`` or decorated
+with it, plus any function a reachable function passes by reference —
+``jax.value_and_grad(loss_fn)`` et al.) and flags, inside them:
+
+- host syncs: ``.block_until_ready()``, ``jax.device_get``,
+  ``.item()``, ``np.asarray``/``np.array``, ``float(x)``/``int(x)`` on
+  a non-literal;
+- wall clocks: ``time.time()``/``perf_counter()``/``monotonic()``;
+- mutation of captured containers: subscript-store, or a
+  ``.update()``/``.setdefault()``/``.pop()``/… call whose result is
+  DISCARDED (an expression statement) on a container that is a
+  parameter or closure variable — a used result means a functional
+  API (``new_state = ls.update(...)``), not mutation; locals are fine
+  either way (the trace owns them);
+- ``print`` (runs once per retrace, not per step — a lie at best).
+
+Resolution is conservative (see ``callgraph.py``): only statically
+certain calls extend reachability, so a finding here is near-certain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import (FunctionInfo, ModuleInfo, Project, dotted_name,
+                         iter_calls, own_statements)
+from ..engine import Finding
+
+RULE = "PT-TRACE"
+
+_CLOCKS = {"time", "perf_counter", "monotonic", "process_time",
+           "thread_time"}
+_SYNC_ATTRS = {"block_until_ready", "device_get", "item"}
+
+
+def _is_jit_expr(project: Project, mod: ModuleInfo,
+                 call: ast.Call) -> bool:
+    chain = dotted_name(call.func)
+    if chain is None:
+        return False
+    parts = chain.split(".")
+    if parts[-1] == "jit":
+        if len(parts) == 1:
+            return mod.from_imports.get("jit", ("", ""))[0] == "jax"
+        return project.names_module(mod, parts[0], "jax")
+    # functools.partial(jax.jit, ...) — treat as jit when arg0 is jit
+    if parts[-1] == "partial" and call.args:
+        inner = dotted_name(call.args[0])
+        if inner and inner.split(".")[-1] == "jit":
+            return True
+    return False
+
+
+def _jit_roots(project: Project) -> Set[FunctionInfo]:
+    roots: Set[FunctionInfo] = set()
+    for mod in project.iter_modules():
+        # decorators
+        for fn in mod.functions.values():
+            for dec in getattr(fn.node, "decorator_list", []):
+                chain = dotted_name(dec if not isinstance(dec, ast.Call)
+                                    else dec.func)
+                if chain and chain.split(".")[-1] == "jit":
+                    roots.add(fn)
+                elif isinstance(dec, ast.Call) \
+                        and _is_jit_expr(project, mod, dec):
+                    roots.add(fn)
+        # jax.jit(f) call sites — resolve f in the enclosing scope
+        for qual, fn in mod.functions.items():
+            for call in iter_calls(fn.node):
+                if not _is_jit_expr(project, mod, call):
+                    continue
+                args = list(call.args)
+                # partial(jax.jit, f): the wrapped fn is args[1]
+                if args and dotted_name(args[0]) \
+                        and dotted_name(args[0]).endswith("jit"):
+                    args = args[1:]
+                for a in args[:1]:
+                    if isinstance(a, ast.Name):
+                        tgt = project.resolve_name(mod, fn, a.id)
+                        if tgt is not None:
+                            roots.add(tgt)
+        # module-level jit calls
+        for call in iter_calls(mod.tree):
+            if _is_jit_expr(project, mod, call) and call.args \
+                    and isinstance(call.args[0], ast.Name):
+                tgt = project.resolve_name(mod, None, call.args[0].id)
+                if tgt is not None:
+                    roots.add(tgt)
+    return roots
+
+
+def _reachable(project: Project,
+               roots: Set[FunctionInfo]) -> Set[FunctionInfo]:
+    seen: Set[FunctionInfo] = set()
+    frontier = list(roots)
+    while frontier:
+        fn = frontier.pop()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        mod = fn.module
+        for call in iter_calls(fn.node):
+            tgt = project.resolve_call(mod, fn, call)
+            if tgt is not None and tgt not in seen:
+                frontier.append(tgt)
+            # function references passed along (value_and_grad(loss_fn),
+            # tree_map(f, ...)) stay inside the traced program
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                if isinstance(a, ast.Name):
+                    ref = project.resolve_name(mod, fn, a.id)
+                    if ref is not None and ref not in seen:
+                        frontier.append(ref)
+    return seen
+
+
+def _float_arg_is_literal(call: ast.Call) -> bool:
+    return bool(call.args) and isinstance(call.args[0], ast.Constant)
+
+
+def _check_function(project: Project, fn: FunctionInfo,
+                    out: List[Finding]) -> None:
+    mod = fn.module
+    # calls whose value is thrown away: only these count as mutation
+    # (`buffers.update(x)` mutates; `new = ls.update(x)` is functional)
+    discarded = {id(n.value) for n in own_statements(fn.node)
+                 if isinstance(n, ast.Expr)
+                 and isinstance(n.value, ast.Call)}
+
+    def is_captured(name: str) -> bool:
+        # a parameter, closure variable, or module global — anything the
+        # trace does not own; plain locals are the trace's to mutate
+        return name in fn.params or name not in fn.locals
+
+    def flag(node: ast.AST, msg: str) -> None:
+        out.append(Finding(RULE, mod.path, node.lineno, node.col_offset,
+                           f"in jit-reachable `{fn.qualname}`: {msg}"))
+
+    for node in own_statements(fn.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            chain = dotted_name(f)
+            # host syncs via attribute
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+                flag(node, f".{f.attr}() forces a host sync inside the "
+                     "traced step — move it outside the jit boundary")
+                continue
+            if chain:
+                parts = chain.split(".")
+                root, leaf = parts[0], parts[-1]
+                if leaf in ("asarray", "array") and \
+                        project.names_module(mod, root, "numpy"):
+                    flag(node, f"np.{leaf}() materializes on host at "
+                         "trace time — use jnp, or feed the value as "
+                         "an argument")
+                    continue
+                if leaf in _CLOCKS and (
+                        project.names_module(mod, root, "time")
+                        or (len(parts) == 1 and mod.from_imports.get(
+                            leaf, ("", ""))[0] == "time")):
+                    flag(node, f"{chain}() reads the wall clock at "
+                         "TRACE time — the compiled step reuses that "
+                         "constant forever")
+                    continue
+            if isinstance(f, ast.Name) and f.id in ("float", "int") \
+                    and node.args and not _float_arg_is_literal(node):
+                flag(node, f"{f.id}() on a traced value host-syncs "
+                     "(or bakes a trace-time constant) — keep it an "
+                     "array, or pass the scalar as an argument")
+                continue
+            if isinstance(f, ast.Name) and f.id == "print":
+                flag(node, "print() runs once per retrace, not per "
+                     "step — use jax.debug.print or host callbacks")
+                continue
+            # captured-container mutation via method (discarded result)
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in ("update", "setdefault", "pop",
+                                   "clear", "append", "extend") \
+                    and isinstance(f.value, ast.Name) \
+                    and is_captured(f.value.id) \
+                    and id(node) in discarded:
+                flag(node, f"`{f.value.id}.{f.attr}(...)` mutates a "
+                     "captured container inside the trace — the "
+                     "round-12 buffers trap (hand the callee a copy)")
+                continue
+        # captured-container mutation via subscript store
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and is_captured(t.value.id):
+                    flag(node, f"`{t.value.id}[...] = ...` mutates a "
+                         "captured container inside the trace — the "
+                         "round-12 buffers trap (build a new dict "
+                         "instead)")
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    roots = _jit_roots(project)
+    for fn in _reachable(project, roots):
+        _check_function(project, fn, out)
+    return out

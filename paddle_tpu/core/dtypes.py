@@ -120,11 +120,14 @@ def current_policy() -> Policy:
 
 @contextlib.contextmanager
 def policy_scope(policy: Policy) -> Iterator[None]:
-    _override.append(policy)
+    # the override stack is a TRACE-TIME construct by design: ops read
+    # it while the jaxpr is built, and the finally rebalances it even
+    # when tracing aborts — no state leaks into the compiled program
+    _override.append(policy)   # ptpu: lint-ok[PT-TRACE] trace-time stack
     try:
         yield
     finally:
-        _override.pop()
+        _override.pop()        # ptpu: lint-ok[PT-TRACE] trace-time stack
 
 
 @contextlib.contextmanager

@@ -52,6 +52,7 @@ import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 from .. import observe
+from ..analysis.lockorder import named_condition, named_lock
 from ..observe import trace
 from ..utils import get_logger
 
@@ -111,8 +112,8 @@ class AsyncPipeline:
         # land in the trace of the pass that consumes them
         self._trace_ctx = trace.current_context()
 
-        self._src_lock = threading.Lock()   # serializes next(_src)
-        self._cond = threading.Condition()  # guards the state below
+        self._src_lock = named_lock("pipeline.source")  # serializes next(_src)
+        self._cond = named_condition("pipeline.queue")  # guards the state below
         self._ready: dict = {}              # index -> (feed, exc|None)
         self._seq = 0                       # next index to read from src
         self._next_out = 0                  # next index the consumer wants
@@ -266,8 +267,10 @@ class AsyncPipeline:
         if close is not None:
             try:
                 close()
-            except Exception:  # noqa: BLE001 — teardown is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("pipeline %r source close failed during "
+                          "teardown: %s: %s", self.name,
+                          type(e).__name__, e)
 
     def __enter__(self) -> "AsyncPipeline":
         return self

@@ -16,6 +16,7 @@ import random
 import threading
 from typing import Any, Callable, Iterable, List, Sequence
 
+from ..utils import get_logger
 from .pipeline import IO_THREAD_PREFIX
 
 Reader = Callable[[], Iterable[Any]]
@@ -44,8 +45,10 @@ def _close_iter(it: Any) -> None:
     if close is not None:
         try:
             close()
-        except Exception:  # noqa: BLE001 — teardown is best-effort
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown is best-effort
+            get_logger("reader").debug(
+                "iterator close failed during teardown: %s: %s",
+                type(e).__name__, e)
 
 
 def np_array(x) -> Reader:

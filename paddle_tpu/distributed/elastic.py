@@ -78,8 +78,9 @@ class ElasticTrainer:
                 counter("elastic_election_releases",
                         "save-model elections released to a peer after "
                         "a local save failure").inc()
-            except Exception:  # noqa: BLE001 — best-effort release
-                pass
+            except Exception as rel_e:  # noqa: BLE001 — best-effort
+                log.debug("save-model election release failed: %s: %s",
+                          type(rel_e).__name__, rel_e)
             log.warning(
                 "checkpoint save failed: epoch=%d force=%s dir=%s "
                 "consecutive=%d/%d error=%s: %s — skipping this window",

@@ -21,6 +21,7 @@ import time
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
+from ..analysis.lockorder import named_lock
 from ..observe import counter, gauge, trace
 from ..utils import FLAGS, PaddleTpuError, enforce, get_logger
 
@@ -152,7 +153,8 @@ class Master:
             if getattr(self, "_h", None):
                 self._lib.ptpu_master_destroy(self._h)
                 self._h = None
-        except Exception:
+        # modules/loggers may already be torn down under us
+        except Exception:   # ptpu: lint-ok[PT-RESOURCE] __del__ teardown
             pass
 
 
@@ -471,8 +473,9 @@ def master_reader(client, load_fn, wait_sleep: float = 0.05,
             try:
                 if open_tid is not None:   # re-queue the abandoned shard
                     client.task_failed(open_tid)
-            except Exception:  # noqa: BLE001 — teardown is best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("abandoned-shard FAIL for task %s lost: %s: %s",
+                          open_tid, type(e).__name__, e)
             if close_client:
                 close = getattr(client, "close", None)
                 if close is not None:
@@ -522,8 +525,8 @@ def _readahead_reader(client, load_fn, wait_sleep: float,
         out_q: "queue.Queue" = queue.Queue(maxsize=depth)
         stop = threading.Event()
         error: List[BaseException] = []
-        call_lock = threading.Lock()   # one socket, two threads
-        tids_lock = threading.Lock()
+        call_lock = named_lock("master.readahead.call")  # one socket, two threads
+        tids_lock = named_lock("master.readahead.tids")
         open_tids: set = set()         # leased, not yet FIN/FAILed
         # the fetcher adopts the consuming pass's trace context so its
         # lease RPCs + chunk loads land in that trace, not a fresh one
@@ -599,8 +602,10 @@ def _readahead_reader(client, load_fn, wait_sleep: float,
                 try:                # + every prefetched-unconsumed one
                     with call_lock:
                         client.task_failed(tid)
-                except Exception:  # noqa: BLE001 — teardown best-effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.debug("read-ahead FAIL for task %s lost during "
+                              "teardown: %s: %s", tid,
+                              type(e).__name__, e)
             if abandoned and close_client:
                 close = getattr(client, "close", None)
                 if close is not None:

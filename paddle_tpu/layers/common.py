@@ -439,7 +439,7 @@ class CosSimVecMatLayer(Layer):
         b, d = vec.shape
         k = mat.shape[1] // d
         m = mat.reshape(b, k, d)
-        dot = jnp.einsum("bd,bkd->bk", vec, m)
+        dot = math_ops.einsum("bd,bkd->bk", vec, m)
         nv = jnp.linalg.norm(vec, axis=-1, keepdims=True)
         nm = jnp.linalg.norm(m, axis=-1)
         out = self.conf.attrs.get("cos_scale", 1.0) * dot / (nv * nm + 1e-10)
@@ -551,7 +551,7 @@ class TensorLayer(Layer):
     def forward(self, params, inputs, ctx):
         x1, x2 = value_of(inputs[0]), value_of(inputs[1])
         w = params[self.weight_name(0)]
-        out = jnp.einsum("bi,kij,bj->bk", x1, w, x2)
+        out = math_ops.einsum("bi,kij,bj->bk", x1, w, x2)
         if self.conf.with_bias:
             out = out + params[self.bias_name()]
         return self.finalize(like(inputs[0], out), ctx)

@@ -26,11 +26,12 @@ import threading
 import time
 from typing import Optional, Tuple
 
+from ..analysis.lockorder import named_lock
 from . import trace
 from .report import prometheus_dump
 
 _installed = False
-_install_lock = threading.Lock()
+_install_lock = named_lock("observe.dump.install")
 
 
 def debug_dump(out_dir: Optional[str] = None) -> Tuple[str, str]:

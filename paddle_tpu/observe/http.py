@@ -36,6 +36,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..analysis.lockorder import named_lock
 from . import trace
 from .report import prometheus_dump
 
@@ -132,7 +133,7 @@ class ObservabilityServer:
 
 
 _global: Optional[ObservabilityServer] = None
-_global_lock = threading.Lock()
+_global_lock = named_lock("observe.http.global")
 
 
 def start_from_flags() -> Optional[ObservabilityServer]:

@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from ..analysis.lockorder import named_lock
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -53,7 +55,7 @@ class _Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = named_lock("observe.metric")
 
     def describe(self) -> Dict[str, Any]:
         return {"name": self.name, "type": self.kind, "help": self.help,
@@ -223,7 +225,7 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("observe.registry")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get(self, cls, name: str, help: str, **kw) -> Any:

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..analysis.lockorder import named_lock
 from .metrics import REGISTRY, MetricsRegistry
 
 
@@ -61,7 +62,7 @@ class MetricsReporter:
         # flush (path fixed, disk freed) clears the state.
         self.degraded = False
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("observe.reporter")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -132,7 +133,7 @@ class MetricsReporter:
                     self._warn_flush_failure(e)
 
         self._thread = threading.Thread(
-            target=loop, name="metrics-reporter", daemon=True)
+            target=loop, name="ptpu-metrics-reporter", daemon=True)
         self._thread.start()
         return self
 
@@ -160,7 +161,7 @@ class MetricsReporter:
 
 # --------------------------------------------------------------- global
 _global: Optional[MetricsReporter] = None
-_global_lock = threading.Lock()
+_global_lock = named_lock("observe.reporter.global")
 
 
 def start_from_flags() -> Optional[MetricsReporter]:

@@ -60,6 +60,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional
 
+from ..analysis.lockorder import named_lock
+
 DEFAULT_RING_SIZE = 4096
 
 #: Thread name of the JSONL writer; the conftest thread-leak guard
@@ -72,7 +74,7 @@ WRITER_THREAD_NAME = "ptpu-trace-writer"
 _EPOCH_OFFSET_S = time.time() - time.perf_counter()
 
 _ids = random.Random()          # span/trace ids need no crypto strength
-_ids_lock = threading.Lock()
+_ids_lock = named_lock("trace.ids")
 
 _tls = threading.local()        # .ctx: the active SpanContext (or None)
 
@@ -139,7 +141,7 @@ class _Recorder:
                  ring_size: int = DEFAULT_RING_SIZE, fences: bool = True):
         self.ring: "collections.deque" = collections.deque(
             maxlen=max(1, int(ring_size)))
-        self._ring_lock = threading.Lock()
+        self._ring_lock = named_lock("trace.ring")
         self.jsonl_path = jsonl_path or None
         # an explicit sink always wants the honest (fenced) timeline;
         # scrape-originated ring-only recording opts out (see
@@ -239,7 +241,7 @@ class _Recorder:
 
 
 _recorder: Optional[_Recorder] = None
-_state_lock = threading.Lock()
+_state_lock = named_lock("trace.state")
 _atexit_installed = False
 
 
@@ -395,8 +397,12 @@ class _Span:
         if self._annot is not None:
             try:
                 self._annot.__exit__(exc_type, exc, tb)
-            except Exception:   # noqa: BLE001 — telemetry never kills
-                pass
+            except Exception as e:  # noqa: BLE001 — telemetry never
+                # kills: the xprof window can close mid-span
+                from ..utils.logger import get_logger
+                get_logger("observe").debug(
+                    "xprof annotation exit failed (window closed "
+                    "mid-span?): %s: %s", type(e).__name__, e)
         _tls.ctx = self._prev
         args = {"trace_id": self.context.trace_id,
                 "span_id": self.context.span_id}

@@ -44,6 +44,28 @@ def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
     return out
 
 
+@register_op("einsum")
+def einsum(subscripts: str, *operands):
+    """MXU einsum under the mixed-precision policy: floating operands
+    cast to the compute dtype, accumulation in the output dtype —
+    the einsum-shaped counterpart of :func:`matmul`, so contraction
+    layers (tensor products, vec-mat cosine) route through
+    ``core/dtypes`` instead of silently pinning the operand dtype."""
+    pol = current_policy()
+    if any(jnp.issubdtype(jnp.result_type(x), jnp.floating)
+           for x in operands):
+        record_op_precision("einsum")
+        operands = tuple(
+            x.astype(pol.compute_dtype)
+            if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x
+            for x in operands)
+        return jnp.einsum(subscripts, *operands,
+                          preferred_element_type=pol.output_dtype)
+    # integer/bool contraction: the policy is a FLOAT compute policy —
+    # forcing its output dtype here would silently promote to float
+    return jnp.einsum(subscripts, *operands)
+
+
 @register_op("sum")
 def sum_arrays(*xs):
     """Sum N same-shape tensors (``paddle/operators/sum_op.cc``)."""

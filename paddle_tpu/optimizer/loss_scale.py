@@ -85,7 +85,11 @@ def update(state: LossScaleState, finite: jax.Array,
     if growth_interval is None:
         growth_interval = FLAGS.loss_scale_growth_interval
     count = state.growth_count + 1
-    grow = count >= jnp.asarray(int(growth_interval), jnp.int32)
+    # growth_interval is a Python flag value, baked as a trace-time
+    # constant on purpose (one compiled step per configured interval)
+    grow = count >= jnp.asarray(
+        int(growth_interval),  # ptpu: lint-ok[PT-TRACE] static flag
+        jnp.int32)
     grown_scale = jnp.where(grow, jnp.minimum(state.scale * GROWTH_FACTOR,
                                               MAX_SCALE),
                             state.scale)

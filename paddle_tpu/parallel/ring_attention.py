@@ -69,6 +69,7 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask):
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
+    # ptpu: lint-ok[PT-DTYPE] fp32-by-design: flash-attention scores
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / jnp.sqrt(q.shape[-1])
     if mask is not None:
         scores = scores + mask[None, None, :, :]
@@ -82,8 +83,9 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, mask):
     alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
                       jnp.exp(m_prev - m_safe))
     l_new = alpha * l_prev + l_cur
-    o_new = alpha[..., None] * o_prev + \
-        jnp.einsum("bhqk,bkhd->bhqd", p, vf)
+    # ptpu: lint-ok[PT-DTYPE] fp32-by-design flash-attention accumulator
+    o_new = alpha[..., None] * o_prev + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, vf)
     return m_new, l_new, o_new
 
 
@@ -189,6 +191,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "data",
 
 def full_attention(q, k, v, causal: bool = False):
     """Single-device reference: softmax(q·kᵀ/√d)·v."""
+    # ptpu: lint-ok[PT-DTYPE] fp32-by-design reference implementation
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) / jnp.sqrt(q.shape[-1])
     if causal:
@@ -197,5 +200,6 @@ def full_attention(q, k, v, causal: bool = False):
                          0.0, NEG_INF)
         scores = scores + mask[None, None]
     w = jax.nn.softmax(scores, axis=-1)
+    # ptpu: lint-ok[PT-DTYPE] fp32-by-design reference implementation
     out = jnp.einsum("bhqk,bkhd->bhqd", w, v.astype(jnp.float32))
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

@@ -32,6 +32,14 @@ class FlagRegistry:
         self._values: Dict[str, Any] = {}
 
     def define(self, name: str, default: Any, help: str = "") -> None:
+        if name in self._specs:
+            # a silent re-registration wins the table and erases the
+            # first definition's default/help — always a collision bug
+            # (two modules claiming one knob), never intentional
+            raise ValueError(
+                f"flag {name!r} is already registered "
+                f"(default={self._specs[name].default!r}); duplicate "
+                "registration would silently replace it")
         if isinstance(default, bool):
             parser: Callable[[str], Any] = _parse_bool
         elif isinstance(default, int):

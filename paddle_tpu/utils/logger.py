@@ -22,6 +22,8 @@ import sys
 import threading
 from typing import Optional, Set, Union
 
+from ..analysis.lockorder import named_lock
+
 _FMT = "%(levelname).1s %(asctime)s.%(msecs)03d %(name)s] %(message)s"
 _DATEFMT = "%m%d %H:%M:%S"
 
@@ -75,7 +77,7 @@ def set_log_level(level: Union[str, int]) -> None:
 
 
 _warned: Set[str] = set()
-_warned_lock = threading.Lock()
+_warned_lock = named_lock("logger.warn_once")
 
 
 def warn_once(key: str, msg: str, *args,

@@ -21,6 +21,7 @@ from typing import Iterator, Optional
 
 import jax
 
+from ..analysis.lockorder import named_lock
 from .logger import get_logger, warn_once
 
 log = get_logger("profiler")
@@ -30,7 +31,7 @@ log = get_logger("profiler")
 # the window and inner uses are warn-once no-ops.  The depth doubles as
 # the "is an xprof window open" signal observe.trace keys on to wrap
 # spans in TraceAnnotations (host-span <-> XLA-op correlation).
-_depth_lock = threading.Lock()
+_depth_lock = named_lock("profiler.depth")
 _trace_depth = 0
 
 

@@ -24,6 +24,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, Optional
 
+from ..analysis.lockorder import named_lock
+
 
 @dataclass
 class StatItem:
@@ -35,8 +37,9 @@ class StatItem:
     # updates and snapshots race (timer threads vs the reporter flush
     # thread); a per-item lock keeps count/total/max/min one consistent
     # tuple instead of a field-by-field torn read
-    _lock: threading.Lock = field(default_factory=threading.Lock,
-                                  repr=False, compare=False)
+    _lock: threading.Lock = field(
+        default_factory=lambda: named_lock("stat.item"),
+        repr=False, compare=False)
 
     def add(self, seconds: float) -> None:
         with self._lock:
@@ -65,7 +68,7 @@ class StatSet:
     def __init__(self, name: str = "global"):
         self.name = name
         self._items: Dict[str, StatItem] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("stat.set")
         self.enabled = True
 
     def item(self, name: str) -> StatItem:
@@ -88,8 +91,13 @@ class StatSet:
                     import jax
 
                     jax.block_until_ready(block_on)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — timing fence is
+                    # best-effort: a deleted buffer must not kill the
+                    # timed computation
+                    from .logger import get_logger
+                    get_logger("stat").debug(
+                        "block_until_ready fence failed for timer %r: "
+                        "%s: %s", name, type(e).__name__, e)
             self.item(name).add(time.perf_counter() - t0)
 
     def reset(self) -> None:

@@ -144,6 +144,25 @@ def rng():
     return np.random.RandomState(42)
 
 
+@pytest.fixture
+def lock_order_check():
+    """Runtime half of PT-LOCK (analysis/lockorder.py), opt-in per
+    suite: every blocking acquire of a `named_lock` records hierarchy
+    edges while the test runs, and teardown asserts no ordering cycle
+    was witnessed — the programmatic twin of
+    PADDLE_TPU_LOCK_ORDER_CHECK=1.  The chaos and pipeline suites pull
+    this through a module-local autouse shim."""
+    from paddle_tpu.analysis import lockorder
+    lockorder.reset()
+    lockorder.enable(raise_on_violation=False)
+    try:
+        yield lockorder
+        lockorder.check_acyclic()
+    finally:
+        lockorder.disable()
+        lockorder.reset()
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_state(_io_thread_leak_guard):
     # depends on the thread-leak guard so THIS teardown (which stops the

@@ -1,0 +1,11 @@
+"""PT-DTYPE fixture: element-wise jnp is fine anywhere; MXU shapes
+route through the ops layer."""
+import jax.numpy as jnp
+
+from paddle_tpu.ops import math_ops
+
+
+def activations(x, w, b):
+    h = math_ops.matmul(x, w)        # policy-routed: clean
+    h = math_ops.einsum("bi,bi->b", h, h)
+    return jnp.tanh(h + b)           # element-wise: no MXU, no policy

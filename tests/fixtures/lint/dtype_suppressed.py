@@ -1,0 +1,8 @@
+"""PT-DTYPE fixture: a deliberate fp32-by-design site, pragma'd."""
+import jax.numpy as jnp
+
+
+def reference_scores(q, k):
+    # ptpu: lint-ok[PT-DTYPE] fp32-by-design reference implementation
+    return jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                      k.astype(jnp.float32))

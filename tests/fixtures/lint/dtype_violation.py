@@ -1,0 +1,27 @@
+"""PT-DTYPE fixture: MXU-shaped ops bypassing the precision policy.
+This file does NOT live under ops/ or core/, so every call is a bypass."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def scores(q, k):
+    return jnp.einsum("bqd,bkd->bqk", q, k)          # line 9
+
+
+def project(x, w):
+    return jnp.dot(x, w)                             # line 13
+
+
+def mm(a, b):
+    return jnp.matmul(a, b)                          # line 17
+
+
+def convolve(x, w):
+    return lax.conv_general_dilated(                 # line 21
+        x, w, (1, 1), "SAME")
+
+
+def general(a, b):
+    return jax.lax.dot_general(                      # line 26
+        a, b, (((1,), (0,)), ((), ())))

@@ -1,0 +1,35 @@
+"""PT-LOCK fixture: consistent ordering and instance locks — acyclic."""
+import threading
+
+from paddle_tpu.analysis.lockorder import named_lock
+
+front = named_lock("fixture.front")
+back = named_lock("fixture.back")
+
+
+def path_one():
+    with front:
+        with back:                      # edge front -> back
+            return 1
+
+
+def path_two():
+    with front:
+        with back:                      # same order: still acyclic
+            return 2
+
+
+class Worker:
+    """Instance locks: two Worker objects are distinct locks under one
+    node name, so peer handoff is not a self-deadlock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def handoff(self, peer):
+        with self._lock:
+            return peer.steal()
+
+    def steal(self):
+        with self._lock:
+            return 0

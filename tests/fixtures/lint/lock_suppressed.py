@@ -1,0 +1,29 @@
+"""PT-LOCK fixture: the same hazards carrying justified pragmas
+(e.g. two phases proven never concurrent by an external barrier)."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+lock_c = threading.Lock()
+
+
+def startup_phase():
+    with lock_a:
+        with lock_b:   # ptpu: lint-ok[PT-LOCK] phases barrier-separated
+            return 1
+
+
+def shutdown_phase():
+    with lock_b:
+        with lock_a:   # ptpu: lint-ok[PT-LOCK] phases barrier-separated
+            return 2
+
+
+def outer():
+    with lock_c:
+        return inner()  # ptpu: lint-ok[PT-LOCK] inner() re-entry audited
+
+
+def inner():
+    with lock_c:
+        return 0

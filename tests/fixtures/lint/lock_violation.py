@@ -1,0 +1,28 @@
+"""PT-LOCK fixture: a two-lock ordering cycle and a self-deadlock."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+lock_c = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:                    # edge a -> b
+            return 1
+
+
+def path_two():
+    with lock_b:
+        with lock_a:                    # edge b -> a: CYCLE
+            return 2
+
+
+def outer():
+    with lock_c:
+        return inner()                  # held c, callee re-acquires c
+
+
+def inner():
+    with lock_c:                        # self-deadlock via outer()
+        return 0

@@ -1,0 +1,19 @@
+"""PT-RECOMPILE fixture: the cache-friendly shapes of the same code."""
+import jax
+
+_cache = {}
+
+
+def _step(y, x):
+    return y * x
+
+
+_jitted = jax.jit(_step)                 # hoisted: one callable, one cache
+
+
+def hot_loop(xs):
+    return [_jitted(x, x) for x in xs]
+
+
+def lookup(shape, dtype):
+    return _cache.get((tuple(shape), str(dtype)))   # tuple key: stable

@@ -1,0 +1,17 @@
+"""PT-RECOMPILE fixture: hazards carrying justified pragmas."""
+import jax
+
+_cache = {}
+
+
+def rebuild_per_shape(shapes):
+    outs = []
+    for s in shapes:
+        # ptpu: lint-ok[PT-RECOMPILE] one compile per dataset epoch, by design
+        f = jax.jit(lambda y: y.reshape(s))
+        outs.append(f)
+    return outs
+
+
+def keyed(shape):
+    return _cache.get(f"{shape}")  # ptpu: lint-ok[PT-RECOMPILE] doc example

@@ -1,0 +1,24 @@
+"""PT-RECOMPILE fixture: every jit-cache hazard class."""
+import jax
+
+_cache = {}
+
+
+def hot_loop(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda y: y * x)        # line 10: jit-in-loop (+closure)
+        outs.append(f(x))
+    return outs
+
+
+def one_shot(x):
+    return jax.jit(lambda y: y + 1)(x)      # line 16: jit-and-call
+
+
+def lookup(shape, dtype):
+    return _cache.get(f"{shape}-{dtype}")   # line 20: f-string cache key
+
+
+def store(arr):
+    _cache[f"{arr.shape}"] = arr            # line 24: f-string subscript key

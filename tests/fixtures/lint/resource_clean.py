@@ -1,0 +1,59 @@
+"""PT-RESOURCE fixture: the hygienic shapes of the same code."""
+import threading
+
+_lock = threading.Lock()
+
+THREAD_NAME_PREFIX = "ptpu-fixture-"
+
+
+class Delegating:
+    """A context manager delegating to another is the ONE legitimate
+    home for manual dunder calls."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __enter__(self):
+        return self._inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self._inner.__exit__(*exc)
+
+
+def with_scoped():
+    with _lock:
+        return compute()
+
+
+def pre_with_idiom():
+    _lock.acquire()
+    try:
+        return compute()
+    finally:
+        _lock.release()
+
+
+def narrow_swallow():
+    try:
+        return compute()
+    except OSError:          # narrow: allowed to pass silently
+        pass
+    except Exception as e:   # broad but NOT silent: logs
+        print("compute failed:", e)
+        raise
+
+
+def spawn():
+    lit = threading.Thread(target=compute, name="ptpu-fixture-worker")
+    pre = threading.Thread(target=compute, name=THREAD_NAME_PREFIX + "w0")
+    fstr = threading.Thread(target=compute, name=f"{THREAD_NAME_PREFIX}w1")
+    dyn = threading.Thread(target=compute, name=unknown_name())  # unresolvable
+    return lit, pre, fstr, dyn
+
+
+def compute():
+    return 0
+
+
+def unknown_name():
+    return "runtime-decided"

@@ -1,0 +1,40 @@
+"""PT-RESOURCE fixture: every hygiene violation class."""
+import threading
+
+_lock = threading.Lock()
+
+
+def manual_ctx(cm):
+    cm.__enter__()                          # line 8: manual enter
+    try:
+        return 1
+    finally:
+        cm.__exit__(None, None, None)       # line 12: manual exit
+
+
+def bare_acquire():
+    _lock.acquire()                         # line 16: no try/finally next
+    value = compute()
+    _lock.release()
+    return value
+
+
+def silent():
+    try:
+        compute()
+    except Exception:                       # line 24: broad silent pass
+        pass
+    try:
+        compute()
+    except:                                 # line 28: bare except
+        raise
+
+
+def spawn():
+    named = threading.Thread(target=silent, name="worker-1")   # line 33
+    anon = threading.Thread(target=silent)                     # line 34
+    return named, anon
+
+
+def compute():
+    return 0

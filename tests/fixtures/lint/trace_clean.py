@@ -1,0 +1,22 @@
+"""PT-TRACE fixture: a pure jitted step, plus host-side code that may
+do anything it likes (not jit-reachable)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _loss(params, feed):
+    h = jnp.tanh(feed["x"] @ params["w"])
+    scratch = {}
+    scratch["h"] = h          # local container: the trace owns it
+    return scratch["h"].sum()
+
+
+step = jax.jit(_loss)
+
+
+def host_loop(reader):
+    t0 = time.time()          # host code: clocks are fine here
+    for feed in reader():
+        print("step", time.time() - t0)

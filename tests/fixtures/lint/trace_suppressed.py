@@ -1,0 +1,14 @@
+"""PT-TRACE fixture: the same impurity, pragma'd both ways."""
+import time
+
+import jax
+
+
+def _loss(params):
+    t0 = time.time()   # ptpu: lint-ok[PT-TRACE] deliberate trace-time stamp
+    # ptpu: lint-ok[PT-TRACE] comment-line pragma governs the next line
+    t1 = time.time()
+    return t0 + t1 + params["w"]
+
+
+step = jax.jit(_loss)

@@ -1,0 +1,27 @@
+"""PT-TRACE fixture: every host-sync / impurity class inside a
+jit-reachable function.  Never imported — parsed by the analyzer only."""
+import time
+
+import jax
+import numpy as np
+
+
+def _helper(params):
+    return params["w"].block_until_ready()          # line 10: host sync
+
+
+def _loss(params, feed, buffers):
+    t0 = time.time()                                # line 14: wall clock
+    buffers["hidden"] = feed["x"]                   # line 15: captured store
+    buffers.update({"k": 1})                        # line 16: captured update
+    host = np.asarray(feed["x"])                    # line 17: host materialize
+    scalar = float(params["w"])                     # line 18: float() sync
+    print("tracing", scalar)                        # line 19: print
+    _helper(params)
+    local = {}
+    local["fine"] = host.sum()                      # local mutation: clean
+    popped = buffers.pop("k")  # USED result = functional API, not flagged
+    return scalar + t0 + local["fine"], popped
+
+
+step = jax.jit(_loss)

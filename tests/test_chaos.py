@@ -32,6 +32,14 @@ from test_distributed import _CountingTrainer, _shard_samples, _tiny_trainer
 pytestmark = pytest.mark.chaos
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_guard(lock_order_check):
+    """The chaos gauntlet interleaves every threaded subsystem (master
+    client, elastic trainer, reporter, stat timers) — run all of it
+    under the runtime PT-LOCK checker (conftest `lock_order_check`)."""
+    yield
+
+
 def _fast_client(port, retry_max=8):
     return MasterClient(f"127.0.0.1:{port}", retry_max=retry_max,
                         retry_base_s=0.01, retry_cap_s=0.2)

@@ -63,6 +63,25 @@ def test_matmul_transpose_scale(rng):
         np.asarray(out), 2 * np.asarray(a) @ np.asarray(b).T, rtol=1e-5)
 
 
+def test_einsum_routes_policy_and_matches(rng):
+    a = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    b = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    out = math_ops.einsum("bd,bd->b", a, b)
+    np.testing.assert_allclose(
+        np.asarray(out), (np.asarray(a) * np.asarray(b)).sum(-1),
+        rtol=1e-5)
+
+
+def test_einsum_preserves_integer_dtype():
+    """The precision policy is a FLOAT compute policy: an integer
+    contraction must come back integer, not silently promoted to the
+    policy's float output dtype."""
+    a = jnp.arange(3, dtype=jnp.int32)
+    out = math_ops.einsum("i,i->", a, a)
+    assert out.dtype == jnp.int32
+    assert int(out) == 5
+
+
 def test_multiplex(rng):
     xs = [jnp.full((3, 2), float(i)) for i in range(4)]
     idx = jnp.asarray([2, 0, 3])

@@ -38,6 +38,14 @@ def _wait_no_io_threads(budget_s: float = 3.0):
     assert not _io_threads(), [t.name for t in _io_threads()]
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_guard(lock_order_check):
+    """Pipeline workers nest the source lock under the queue condition
+    across threads — run every test under the runtime PT-LOCK checker
+    (conftest `lock_order_check`) to witness deadlock-freedom."""
+    yield
+
+
 @pytest.fixture
 def prefetch_flags():
     """Save/restore the pipeline flags a test mutates."""
