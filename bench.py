@@ -72,6 +72,7 @@ dumps a per-workload ``jax.profiler`` trace (path on the JSON line as
 
 import argparse
 import json
+import sys
 import time
 from functools import partial as _partial
 
@@ -79,52 +80,71 @@ import jax
 import numpy as np
 
 from paddle_tpu import observe
+from paddle_tpu.observe import benchgate
+from paddle_tpu.observe import costmodel
+from paddle_tpu.observe import memory as omem
 from paddle_tpu.utils import FLAGS
 
-PEAK_FLOPS_BF16 = 197e12      # v5e chip peak, bf16
 TRAIN_FLOP_FACTOR = 3.0       # fwd + bwd ≈ 3× fwd matmul FLOPs
 
 # --profile: per-workload jax.profiler trace dump directory (None = off)
 PROFILE_DIR = None
 
-
-def _hbm_gb_per_step(trainer, feed):
-    """Estimated HBM traffic of ONE jitted train step, in GB, from
-    XLA's compiled cost analysis ('bytes accessed').  This is a static
-    compiler estimate (it counts operand+output bytes over all emitted
-    kernels and cannot see cache-resident reuse), but *deltas across
-    lowerings* — e.g. ``--conv_bn_fuse_fwd`` on vs off — track real
-    traffic changes, which is what the field exists for (the round-7
-    forward-fusion arithmetic in PERF_NOTES).  None when the backend
-    doesn't report the counter.  The lower+compile here hits the
-    persistent compile cache set up in :func:`main` (the step was
-    already compiled by the timing run)."""
-    try:
-        import jax.numpy as jnp
-
-        trainer.train_one_batch(feed)        # ensure built + compiled
-        sfeed = trainer._shard_feed(feed)
-        args = (trainer.params, trainer.opt_state, trainer.buffers,
-                sfeed, jax.random.PRNGKey(0), jnp.zeros((), jnp.float32))
-        if getattr(trainer, "_ls_state", None) is not None:
-            args += (trainer._ls_state,)     # --precision=bf16 step
-        lowered = trainer._train_step.lower(*args)
-        ca = lowered.compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        b = ca.get("bytes accessed")
-        return None if b is None else round(float(b) / 1e9, 2)
-    except Exception:            # noqa: BLE001 — best-effort artifact field
-        return None
+#: Fields `_finish` stamps on a row — composite lanes and the resnet
+#: best-of merge copy exactly this set from the attempt that carried
+#: the analysis.
+PERF_STAMP_FIELDS = (
+    "hbm_gb_per_step", "regions", "regions_elided", "flop_agreement",
+    "opaque_custom_calls", "hbm_peak_bytes", "hbm_in_use_bytes",
+    "hbm_categories", "mfu_est", "mfu_source", "flops_per_step",
+    "trace_dir",
+)
 
 
-def _finish(r, tag, trainer, feed):
-    """Attach the per-workload artifact extras to a result line: the
-    ``hbm_gb_per_step`` estimate always, and under ``--profile`` a
-    jax.profiler trace of a few production train steps (dumped to
-    <profile_dir>/<tag>, path recorded on the line) so traffic deltas
-    are inspectable without a manual xprof session."""
-    r["hbm_gb_per_step"] = _hbm_gb_per_step(trainer, feed)
+def _finish(r, tag, trainer, feed, step_ms=None, hint_flops=None):
+    """Attach the performance-observatory stamp to a result line:
+
+    - ``regions``: the per-fused-region FLOPs / HBM-bytes / roofline
+      attribution of the compiled train step, keyed to network layer
+      names (observe/costmodel.py); ``hbm_gb_per_step`` stays the
+      whole-step XLA 'bytes accessed' figure round 7 introduced;
+    - ``hbm_peak_bytes`` / ``hbm_in_use_bytes`` / ``hbm_categories``:
+      the device-memory accounting snapshot (observe/memory.py —
+      params / opt_state / buffers / data attribution by buffer
+      identity);
+    - ``mfu_est``: THE shared MFU implementation
+      (:func:`paddle_tpu.observe.costmodel.step_mfu` — executed-step
+      FLOPs over time x detected peak x chips), replacing the
+      per-workload hand formulas; those formulas survive only as
+      ``hint_flops``, the analytic fallback for steps whose FLOPs hide
+      inside opaque Pallas custom calls (``mfu_source`` says which
+      source produced the number);
+    - under ``--profile`` a jax.profiler trace of a few production
+      steps (path on the line as ``trace_dir``).
+
+    The cost analysis is memoized per ``tag`` — it is a property of the
+    lowering, identical across timing attempts."""
+    report = costmodel.analyze_trainer_step(trainer, feed,
+                                            cache_key=tag)
+    if report is not None:
+        r["hbm_gb_per_step"] = round(report["xla_bytes"] / 1e9, 2) \
+            if report["xla_bytes"] else None
+        r["regions"] = report["regions"]
+        r["regions_elided"] = report["regions_elided"]
+        r["flop_agreement"] = report["flop_agreement"]
+        if report["opaque_custom_calls"]:
+            r["opaque_custom_calls"] = report["opaque_custom_calls"]
+    else:
+        r["hbm_gb_per_step"] = None
+        r["regions"] = None
+    snap = omem.sample(trainer, feed)
+    r["hbm_peak_bytes"] = snap["peak_bytes"]
+    r["hbm_in_use_bytes"] = snap["in_use_bytes"]
+    r["hbm_categories"] = snap["categories"]
+    if step_ms is not None:
+        r.update(costmodel.step_mfu(
+            trainer, feed, step_ms / 1e3, devices=_n_chips(trainer),
+            fallback_flops=hint_flops, cache_key=tag))
     if PROFILE_DIR:
         import os
 
@@ -280,15 +300,15 @@ def _bench_lstm_row(hidden, baseline_ms, metric, iters=256):
 
     ms, agree = _scan_time_ms(trainer, feed, iters=iters)
     n = _n_chips(trainer)
-    # fwd matmul FLOPs: layer1 x-proj [B,E]→[B,4H] + h-proj [B,H]→[B,4H],
-    # layer2 both projections from H; per timestep, ×T
+    # analytic fwd matmul FLOPs: layer1 x-proj [B,E]→[B,4H] + h-proj
+    # [B,H]→[B,4H], layer2 both projections from H; per timestep, ×T —
+    # the MFU fallback when the fused Pallas path hides the FLOPs from
+    # XLA (the shared implementation in observe/costmodel.py decides)
     fwd = 2 * B * T * (E * 4 * H + H * 4 * H + H * 4 * H + H * 4 * H)
-    mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
     r = {
         "metric": metric,
         "value": round(ms, 3),
         "unit": f"ms/batch (bs=128, hidden={H}, 2xLSTM, T=100)",
-        "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
         "path": _rnn_path("lstm", B, H),
@@ -299,7 +319,8 @@ def _bench_lstm_row(hidden, baseline_ms, metric, iters=256):
                                  "at 1280")
     else:
         r["vs_baseline"] = round(baseline_ms / ms, 3)
-    return _finish(_with_band(r), f"lstm{H}", trainer, feed)
+    return _finish(_with_band(r), f"lstm{H}", trainer, feed,
+                   step_ms=ms, hint_flops=TRAIN_FLOP_FACTOR * fwd)
 
 
 def _rnn_path(kind, b, h):
@@ -363,21 +384,24 @@ def _bench_resnet_once(extras=True):
     n = _n_chips(trainer)
     sps_chip = B / (ms / 1e3) / n
     # 3.858 GMACs fwd @224²: exact conv+fc MAC count of THIS config
-    # (summed from the parsed topology; the model is ResNet-50 v1)
-    fwd_flops_per_img = 3.858e9 * 2
-    mfu = TRAIN_FLOP_FACTOR * fwd_flops_per_img * sps_chip / PEAK_FLOPS_BF16
+    # (summed from the parsed topology; the model is ResNet-50 v1) —
+    # the analytic fallback when Pallas conv custom calls hide FLOPs
+    hint = TRAIN_FLOP_FACTOR * 3.858e9 * 2 * B
+    mfu = costmodel.step_mfu(trainer, feed, ms / 1e3, devices=n,
+                             fallback_flops=hint, cache_key="resnet")
     r = {
         "metric": "resnet50_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
         "unit": f"samples/sec/chip (bs={B}, 224x224, train step)",
         "vs_baseline": round(sps_chip / 95.0, 3),  # published P40 fp32 ~95/s
-        "mfu_est": round(mfu, 3),
+        **mfu,
         "devices": n,
         "timing_self_check": round(agree, 3),
     }
     # the traffic estimate is a property of the LOWERING, identical
     # across attempts — compute it (and any --profile trace) once
-    return _finish(r, "resnet", trainer, feed) if extras else r
+    return _finish(r, "resnet", trainer, feed, step_ms=ms,
+                   hint_flops=hint) if extras else r
 
 
 def bench_resnet():
@@ -409,9 +433,10 @@ def bench_resnet():
         jax.clear_caches()
     best = dict(max(results, key=lambda r: r["value"]))
     best["best_of_attempts"] = len(results)
-    for k in ("hbm_gb_per_step", "trace_dir"):   # extras live on attempt 0
-        if k in results[0]:
-            best[k] = results[0][k]
+    for k in PERF_STAMP_FIELDS:         # extras live on attempt 0
+        if k in results[0] and k not in ("mfu_est", "mfu_source",
+                                         "flops_per_step"):
+            best[k] = results[0][k]     # mfu_* stay the best attempt's
     return _with_band(best, [r["value"] for r in results],
                       [r["mfu_est"] for r in results])
 
@@ -487,13 +512,11 @@ def bench_seq2seq():
     ms, agree = _scan_time_ms(trainer, feed, iters=128)
     n = _n_chips(trainer)
     tokens_per_sec = B * T_LEN / (ms / 1e3)
-    # dominant matmuls fwd: encoder 2×GRU (3H gates from E and H) over
-    # S_LEN; decoder per step: attention proj + inproj (2H+E→3H) + GRU
-    # (H→3H) + softmax H→V
+    # analytic fwd matmuls (the MFU fallback): encoder 2×GRU (3H gates
+    # from E and H) over S_LEN; decoder per step: attention proj +
+    # inproj (2H+E→3H) + GRU (H→3H) + softmax H→V
     enc = 2 * 2 * B * S_LEN * (E * 3 * H + H * 3 * H)
     dec = 2 * B * T_LEN * ((2 * H + E) * 3 * H + H * 3 * H + H * V)
-    mfu = TRAIN_FLOP_FACTOR * (enc + dec) / (ms / 1e3) / \
-        (PEAK_FLOPS_BF16 * n)
     return _finish(_with_band({
         "metric": "seq2seq_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
@@ -503,11 +526,11 @@ def bench_seq2seq():
         # is honest, so vs_baseline is intentionally absent — MFU is the
         # comparable figure
         "vs_baseline_note": "no published reference seq2seq number",
-        "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
         "path": _rnn_path("gru", B, H),
-    }), "seq2seq", trainer, feed)
+    }), "seq2seq", trainer, feed, step_ms=ms,
+        hint_flops=TRAIN_FLOP_FACTOR * (enc + dec))
 
 
 def bench_attention():
@@ -538,10 +561,10 @@ def bench_attention():
     ms, agree = _scan_time_ms(trainer, feed, iters=32)
     n = _n_chips(trainer)
     tokens_per_sec = B * T / (ms / 1e3)
-    # fwd MACs/layer: qkv B·T·D·3D + scores B·T²·D + p·v B·T²·D +
-    # out-proj B·T·D·D + ffn B·T·2·D·F; embedding/head negligible
+    # analytic fwd MACs/layer (MFU fallback — the flash-attention
+    # Pallas kernel hides its FLOPs from XLA): qkv B·T·D·3D + scores
+    # B·T²·D + p·v B·T²·D + out-proj B·T·D·D + ffn B·T·2·D·F
     fwd = 2 * L * B * T * (3 * D * D + 2 * T * D + D * D + 2 * D * F)
-    mfu = TRAIN_FLOP_FACTOR * fwd / (ms / 1e3) / (PEAK_FLOPS_BF16 * n)
     return _finish(_with_band({
         "metric": "transformer_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
@@ -549,10 +572,10 @@ def bench_attention():
                 "flash attention)",
         "vs_baseline_note": "reference predates transformers; no "
                             "published number",
-        "mfu_est": round(mfu, 3),
         "devices": n,
         "timing_self_check": round(agree, 3),
-    }), "attention", trainer, feed)
+    }), "attention", trainer, feed, step_ms=ms,
+        hint_flops=TRAIN_FLOP_FACTOR * fwd)
 
 
 # --pipeline_small: CPU-runnable shapes for the prefetch A/B lane
@@ -740,6 +763,7 @@ def bench_pipeline():
 
     depth = max(FLAGS.prefetch_depth, 2)
     rows = []
+    stamp = {}
     with tempfile.TemporaryDirectory(prefix="ptpu-bench-pipeline-") \
             as tmp:
         for tag, build in (("lstm_text_cls", _pipeline_lstm),
@@ -754,6 +778,13 @@ def bench_pipeline():
                 "speedup": round(speedup, 3),
                 "ratio_ok": ab["prefetch"]["input_bound_ratio"] < 0.05,
             })
+            if tag == "lstm_text_cls":
+                # the lane's perf stamp (regions/memory/MFU) describes
+                # its first workload — the LSTM row, re-fed one
+                # converted batch from the same recordio reader
+                feed = feeder.convert(next(iter(reader())))
+                _finish(stamp, "pipeline", trainer, feed,
+                        step_ms=ab["prefetch"]["ms_per_batch"])
     worst = max(r["prefetch"]["input_bound_ratio"] for r in rows)
     r = {
         "metric": "input_pipeline_bound_ratio_max",
@@ -768,6 +799,8 @@ def bench_pipeline():
         "reader_workers": FLAGS.reader_workers,
         "scale": "small" if PIPELINE_SMALL else "bench",
         "rows": rows,
+        "perf_stamp_of": "lstm_text_cls",
+        **stamp,
     }
     return _with_band(r)
 
@@ -946,6 +979,7 @@ def bench_precision():
                   else "resnet20_cifar", _prec_resnet, 0.45),
                  ("transformer", _prec_transformer, 0.35)]
     rows = []
+    stamp = {}
     try:
         # the legacy knobs would make the "fp32" lane bf16 on TPU;
         # force them off so --precision is the only variable
@@ -958,11 +992,18 @@ def bench_precision():
                 trainer, feed, fwd_flops = build()
                 ms, agree = _scan_time_ms(trainer, feed, iters=iters)
                 n = _n_chips(trainer)
-                mfu = TRAIN_FLOP_FACTOR * fwd_flops / (ms / 1e3) \
-                    / (PEAK_FLOPS_BF16 * n)
-                per[prec] = {"ms_per_batch": round(ms, 3),
-                             "mfu_est": round(mfu, 3),
+                hint = TRAIN_FLOP_FACTOR * fwd_flops
+                mfu = costmodel.step_mfu(
+                    trainer, feed, ms / 1e3, devices=n,
+                    fallback_flops=hint,
+                    cache_key=f"precision-{tag}-{prec}")
+                per[prec] = {"ms_per_batch": round(ms, 3), **mfu,
                              "timing_self_check": round(agree, 3)}
+                if tag == workloads[-1][0] and prec == "bf16":
+                    # lane perf stamp: the last workload's bf16 step
+                    # (analysis BEFORE the trainer is torn down)
+                    _finish(stamp, f"precision-{tag}-{prec}", trainer,
+                            feed, step_ms=ms, hint_flops=hint)
                 del trainer
                 jax.clear_caches()
             speedup = per["fp32"]["ms_per_batch"] \
@@ -992,6 +1033,8 @@ def bench_precision():
         "scale": "small" if PRECISION_SMALL else "bench",
         "rows": rows,
         "serving": serving,
+        "perf_stamp_of": f"{workloads[-1][0]}.bf16",
+        **stamp,
     })
 
 
@@ -1070,7 +1113,7 @@ def bench_observe():
     disabled_us = (time.perf_counter() - t0) / n_calls * 1e6 \
         * spans_per_step
 
-    return _with_band({
+    return _finish(_with_band({
         "metric": "observe_trace_overhead_us_per_step",
         "value": round(overhead_us, 1),
         "unit": ("traced − untraced per-step wall time, µs (LSTM "
@@ -1089,7 +1132,8 @@ def bench_observe():
         # per-mode attempt lists above carry the variability; the
         # signed per-attempt deltas would make the band's relative
         # spread meaningless, so the band is the median alone
-    })
+    }), "observe", trainer, feed,
+        step_ms=float(np.median(off_ms)))
 
 
 def _precision_stamp():
@@ -1115,7 +1159,43 @@ def _workload_metrics(before):
     return out
 
 
-def main():
+def _read_jsonl_lines(path):
+    out = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                d = json.loads(raw)
+            except ValueError:
+                continue                # log noise between rows
+            if isinstance(d, dict) and ("metric" in d or "error" in d):
+                out.append(d)
+    return out
+
+
+def _run_gate(lines, args):
+    """``--check`` / ``--check_report_only``: judge this run's lines
+    against ``--baseline`` and return the process exit code.  The human
+    diff table goes to stderr — stdout stays the machine-parsed JSONL
+    stream (the driver reads the FIRST line)."""
+    baseline = benchgate.load_baseline(args.baseline)
+    res = benchgate.compare(lines, baseline)
+    for row in res.regressions:
+        observe.counter(
+            "bench_regressions_total",
+            "bench series that tripped the perf-regression gate "
+            "(--check vs the committed baseline)").inc(
+            series=row["series"])
+    print(benchgate.render_table(res, args.baseline), file=sys.stderr,
+          flush=True)
+    if res.ok or args.check_report_only:
+        return 0
+    return 2
+
+
+def main(argv=None):
     # persistent compile cache: cuts a resnet attempt from ~3.5 to ~2
     # minutes (the driver's run inherits warm compiles from the build's
     # runs when the workspace persists; harmless when cold)
@@ -1126,11 +1206,12 @@ def main():
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
+    lanes = ["lstm", "resnet", "seq2seq", "attention", "lstm1280",
+             "lstm2048", "pipeline", "precision", "observe"]
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
-                    choices=["lstm", "resnet", "seq2seq", "attention",
-                             "lstm1280", "lstm2048", "pipeline",
-                             "precision", "observe"])
+                    help="run a subset of lanes (comma-separated): "
+                         + ",".join(lanes))
     ap.add_argument("--pipeline_small", action="store_true",
                     help="run the input-pipeline A/B lane at CPU-"
                          "runnable shapes (the JSON line records "
@@ -1146,11 +1227,34 @@ def main():
                          "line as trace_dir")
     ap.add_argument("--profile_dir", default="./profiles",
                     help="root directory for --profile trace dumps")
+    # ---- perf-regression gate (observe/benchgate.py)
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline document for --check / context for "
+                         "--write-baseline (benchmark/baselines/*.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="after the run (or --from_jsonl replay), "
+                         "compare every series against --baseline: "
+                         "human diff table on stderr, "
+                         "bench_regressions_total per tripped series, "
+                         "exit 2 on regression")
+    ap.add_argument("--check_report_only", action="store_true",
+                    help="with --check: print the diff table but "
+                         "always exit 0 (CI report mode)")
+    ap.add_argument("--write-baseline", "--write_baseline",
+                    dest="write_baseline", default=None, metavar="FILE",
+                    help="write this run's lines as a baseline "
+                         "document (median ± spread-derived tolerance "
+                         "per series) for future --check runs")
+    ap.add_argument("--from_jsonl", default=None, metavar="FILE",
+                    help="replay previously-emitted bench JSON lines "
+                         "instead of executing workloads — re-gate an "
+                         "old artifact (BENCH_r*.json tail) without a "
+                         "multi-minute run")
     # framework flags ride the same CLI (e.g. --fused_rnn_hblock=false
     # for an A/B of the blocked RNN tier against the scan path, or
     # --metrics_jsonl/--log_level for the telemetry satellites)
-    import sys
-    args = ap.parse_args(FLAGS.parse_argv(sys.argv[1:]))
+    args = ap.parse_args(FLAGS.parse_argv(
+        sys.argv[1:] if argv is None else list(argv)))
     if FLAGS.get("log_level"):
         from paddle_tpu.utils import set_log_level
         set_log_level(FLAGS.get("log_level"))
@@ -1164,28 +1268,53 @@ def main():
     if args.precision_small:
         global PRECISION_SMALL
         PRECISION_SMALL = True
-    benches = {"lstm": bench_lstm, "resnet": bench_resnet,
-               "seq2seq": bench_seq2seq, "attention": bench_attention,
-               "lstm1280": bench_lstm_1280, "lstm2048": bench_lstm_2048,
-               "pipeline": bench_pipeline, "precision": bench_precision,
-               "observe": bench_observe}
-    order = [args.only] if args.only else ["lstm", "resnet", "seq2seq",
-                                           "attention", "lstm1280",
-                                           "lstm2048", "pipeline",
-                                           "precision", "observe"]
-    for name in order:
-        try:
-            before = observe.REGISTRY.flat(kinds=("counter",))
-            r = benches[name]()
-            r["precision_policy"] = _precision_stamp()
-            r["metrics"] = _workload_metrics(before)
+    if (args.check or args.check_report_only) and not args.baseline:
+        ap.error("--check requires --baseline FILE")
+
+    lines = []
+    if args.from_jsonl:
+        lines = _read_jsonl_lines(args.from_jsonl)
+    else:
+        benches = {"lstm": bench_lstm, "resnet": bench_resnet,
+                   "seq2seq": bench_seq2seq,
+                   "attention": bench_attention,
+                   "lstm1280": bench_lstm_1280,
+                   "lstm2048": bench_lstm_2048,
+                   "pipeline": bench_pipeline,
+                   "precision": bench_precision,
+                   "observe": bench_observe}
+        order = [t.strip() for t in args.only.split(",") if t.strip()] \
+            if args.only else lanes
+        unknown = [t for t in order if t not in benches]
+        if unknown:
+            ap.error(f"unknown lane(s) {unknown}; choose from {lanes}")
+        for name in order:
+            try:
+                before = observe.REGISTRY.flat(kinds=("counter",))
+                r = benches[name]()
+                r["precision_policy"] = _precision_stamp()
+                r["metrics"] = _workload_metrics(before)
+            except Exception as e:      # noqa: BLE001 — report, don't die
+                if name == order[0] and not (args.check
+                                             or args.write_baseline):
+                    raise               # the parsed line must be honest
+                r = {"metric": name, "error": str(e)}
             print(json.dumps(r), flush=True)
-        except Exception as e:          # noqa: BLE001 — report, don't die
-            if name == order[0]:
-                raise                   # the parsed line must be honest
-            print(json.dumps({"metric": name, "error": str(e)}),
-                  flush=True)
+            lines.append(r)
+
+    if args.write_baseline:
+        doc = benchgate.write_baseline(
+            args.write_baseline, lines,
+            meta={"scale": ("small" if PIPELINE_SMALL
+                            or PRECISION_SMALL else "bench"),
+                  "argv": sys.argv[1:] if argv is None else list(argv)})
+        print(f"wrote baseline {args.write_baseline} "
+              f"({len(doc['series'])} series)", file=sys.stderr,
+              flush=True)
+    if args.check or args.check_report_only:
+        return _run_gate(lines, args)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -28,7 +28,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m paddle_tpu.analysis",
         description="ptpu-lint: framework-invariant static analysis "
                     "(PT-TRACE, PT-RECOMPILE, PT-RESOURCE, PT-DTYPE, "
-                    "PT-LOCK)")
+                    "PT-LOCK, PT-METRIC)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to analyze (default: the installed "
                         "paddle_tpu package)")

@@ -25,7 +25,7 @@ from .callgraph import ModuleInfo, Project
 
 #: Every rule family, in report order.
 RULE_CODES = ("PT-TRACE", "PT-RECOMPILE", "PT-RESOURCE", "PT-DTYPE",
-              "PT-LOCK")
+              "PT-LOCK", "PT-METRIC")
 
 _PRAGMA_RE = re.compile(
     r"#\s*ptpu:\s*lint-ok\[([A-Za-z0-9_, \-]+)\]")

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from .dtype_policy import run as _dtype
 from .lock_order import run as _lock
+from .metric_names import run as _metric
 from .recompile import run as _recompile
 from .resource import run as _resource
 from .trace_purity import run as _trace
@@ -14,6 +15,7 @@ ALL_RULES = {
     "PT-RESOURCE": _resource,
     "PT-DTYPE": _dtype,
     "PT-LOCK": _lock,
+    "PT-METRIC": _metric,
 }
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,157 @@
+"""PT-METRIC — metric/span names must be string constants.
+
+The metrics registry (``observe/metrics.py``) and the span recorder
+(``observe/trace.py``) key on their ``name`` argument: every distinct
+name is a new registry entry / a new series in the JSONL sink and the
+Prometheus dump.  A name built at the call site from runtime values —
+``counter(f"rnn_{kind}_total")``, ``span("step_" + str(i))`` — is an
+**unbounded-cardinality leak**: the registry grows without bound, every
+flush serializes the whole accumulated family, and dashboards see a new
+metric per request instead of one metric with labels.  The fix is
+always the same: a literal name, variability in labels
+(``counter("rnn_dispatch_total").inc(kind=kind)``) or span attrs
+(``span("train_step", step=i)``).
+
+Flagged registration sites (resolution deliberately under-approximate,
+matching the other rules' no-false-positive discipline):
+
+- ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` — bare names
+  imported from :mod:`paddle_tpu.observe` (or ``observe.metrics``),
+  or attribute calls on ``observe`` / ``REGISTRY`` / a name that
+  resolves to the observe module;
+- ``trace.span(...)`` / ``trace.record_span(...)`` — same treatment
+  against :mod:`paddle_tpu.observe.trace`.
+
+A ``Name`` argument that is a module-level string constant (the
+``SERVER_THREAD_NAME`` pattern) counts as constant — the cardinality
+is still one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..callgraph import ModuleInfo, Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-METRIC"
+
+_REGISTRY_FNS = ("counter", "gauge", "histogram")
+_TRACE_FNS = ("span", "record_span")
+
+
+def _module_is(full: str, *targets: str) -> bool:
+    return any(full == t or full.endswith("." + t) for t in targets)
+
+
+def _imported_from(mod: ModuleInfo, name: str, *targets: str) -> bool:
+    """``name`` is a from-import binding a MODULE that matches
+    ``targets`` (``from paddle_tpu import observe``)."""
+    fi = mod.from_imports.get(name)
+    if fi is None:
+        return False
+    full = (fi[0] + "." + fi[1]) if fi[0] else fi[1]
+    return _module_is(full, *targets)
+
+
+def _fn_imported_from(mod: ModuleInfo, name: str, *targets: str) -> bool:
+    """``name`` is a from-import binding a FUNCTION defined in a module
+    that matches ``targets`` (``from paddle_tpu.observe import
+    counter``)."""
+    fi = mod.from_imports.get(name)
+    return fi is not None and _module_is(fi[0], *targets)
+
+
+def _base_is_observe(mod: ModuleInfo, base: str) -> bool:
+    if base in ("observe", "REGISTRY"):
+        return True
+    return _imported_from(mod, base, "observe", "observe.metrics") \
+        or _module_is(mod.imports.get(base, ""), "observe",
+                      "observe.metrics")
+
+
+def _base_is_trace(mod: ModuleInfo, parts: List[str]) -> bool:
+    # observe.trace.span(...): a `trace` component counts only when the
+    # chain's base resolves to the observe package — `self.trace.span`
+    # on some unrelated tracer object must NOT match (the rule's
+    # no-false-positive discipline)
+    if len(parts) >= 3 and parts[-2] == "trace" \
+            and _base_is_observe(mod, parts[0]):
+        return True
+    base = parts[0]
+    return _imported_from(mod, base, "observe.trace") \
+        or _module_is(mod.imports.get(base, ""), "observe.trace")
+
+
+def _is_registration(mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+    """The registration family ("metric" | "span") this call belongs
+    to, or None."""
+    chain = dotted_name(call.func)
+    if chain is None:
+        return None
+    parts = chain.split(".")
+    last = parts[-1]
+    if last in _REGISTRY_FNS:
+        if len(parts) == 1:
+            if _fn_imported_from(mod, last, "observe",
+                                 "observe.metrics"):
+                return "metric"
+            return None
+        if _base_is_observe(mod, parts[0]):
+            return "metric"
+        return None
+    if last in _TRACE_FNS:
+        if len(parts) == 1:
+            if _fn_imported_from(mod, last, "observe.trace"):
+                return "span"
+            return None
+        if _base_is_trace(mod, parts):
+            return "span"
+    return None
+
+
+def _describe(arg: ast.AST) -> str:
+    if isinstance(arg, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(arg, ast.BinOp):
+        return "a concatenation/expression"
+    if isinstance(arg, ast.Name):
+        return f"the variable {arg.id!r}"
+    if isinstance(arg, ast.Call):
+        return "a call result"
+    return f"a {type(arg).__name__} expression"
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            family = _is_registration(mod, node)
+            if family is None:
+                continue
+            arg: Optional[ast.AST] = node.args[0] if node.args else None
+            if arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        arg = kw.value
+                        break
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                continue
+            if isinstance(arg, ast.Name) \
+                    and arg.id in mod.str_constants:
+                continue        # module-level literal: cardinality one
+            kind = "metric" if family == "metric" else "span"
+            out.append(Finding(
+                RULE, mod.path, arg.lineno, arg.col_offset,
+                f"{kind} name is {_describe(arg)} — a dynamic name at "
+                "a registration site is an unbounded-cardinality leak "
+                "in the registry and the JSONL/Prometheus sinks; use a "
+                "string literal and put the variability in "
+                f"{'labels' if kind == 'metric' else 'span attrs'}"))
+    return out

@@ -150,7 +150,11 @@ class RecurrentGroup:
         """Run ``names`` (already topo-ordered) in place over ``values``."""
         for name in names:
             layer = self.layers[name]
-            with layer_stack.guard(name + "@" + self.sub.name):
+            # named_scope keys the step layer's compiled regions back to
+            # it for cost attribution ("." separator: XLA's op_name
+            # sanitizer strips "@" and everything after it)
+            with layer_stack.guard(name + "@" + self.sub.name), \
+                    jax.named_scope(name + "." + self.sub.name):
                 inputs = []
                 for iname in layer.conf.input_names():
                     if iname in values:

@@ -47,7 +47,11 @@ from .report import (  # noqa: F401
 )
 from .report import start_from_flags as _start_reporter_from_flags
 from .report import stop_global as _stop_reporter_global
-from . import dump, http, trace  # noqa: F401 — submodule API
+from . import benchgate, dump, http, memory, trace  # noqa: F401
+# costmodel is NOT imported eagerly: its analysis entry points touch
+# jax (lazily), and keeping it an explicit `from paddle_tpu.observe
+# import costmodel` preserves this package's import-time zero-dep rule
+# exactly as before.
 
 
 def start_from_flags():
@@ -78,5 +82,5 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "counter", "gauge", "histogram",
     "format_labels", "MetricsReporter", "active", "attach",
     "prometheus_dump", "start_from_flags", "stop_global",
-    "trace", "http", "dump",
+    "trace", "http", "dump", "memory", "benchgate",
 ]

@@ -166,6 +166,14 @@ def start_from_flags() -> Optional[ObservabilityServer]:
     return _global
 
 
+def serving() -> bool:
+    """True iff the process-wide observability endpoint is live —
+    samplers that only matter when someone can scrape them (the
+    trainer's pass-boundary HBM gauges) key on this together with
+    ``observe.active()``."""
+    return _global is not None
+
+
 def stop_global() -> None:
     global _global
     with _global_lock:

@@ -186,6 +186,46 @@ class Histogram(_Metric):
             s = self._series.get(_label_key(labels))
             return s[2] if s else 0
 
+    #: Derived quantiles exported with every histogram (p50/p95/p99) —
+    #: step-latency SLOs become checkable straight off ``/metrics`` /
+    #: the JSONL sink, no Prometheus server required.
+    EXPORT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimated ``q``-quantile (0 < q <= 1) from the fixed buckets,
+        Prometheus ``histogram_quantile`` style: linear interpolation
+        inside the bucket the rank falls in.  Observations past the last
+        finite bound clamp to it (the +Inf bucket has no width to
+        interpolate over).  None with no observations."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s[2] == 0:
+                return None
+            counts, n = list(s[0]), s[2]
+        rank = q * n
+        acc, lo = 0.0, 0.0
+        for i, ub in enumerate(self.buckets):
+            prev = acc
+            acc += counts[i]
+            if acc >= rank:
+                if counts[i] == 0:        # rank == prev on an empty bucket
+                    return lo
+                frac = min(max((rank - prev) / counts[i], 0.0), 1.0)
+                return lo + (ub - lo) * frac
+            lo = ub
+        return self.buckets[-1]
+
+    def quantiles(self, **labels) -> Dict[str, float]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` (empty when no
+        observations) — the derived series :meth:`samples` and the
+        Prometheus dump export."""
+        out: Dict[str, float] = {}
+        for q in self.EXPORT_QUANTILES:
+            v = self.quantile(q, **labels)
+            if v is not None:
+                out[f"p{int(q * 100)}"] = v
+        return out
+
     def sum(self, **labels) -> float:
         with self._lock:
             s = self._series.get(_label_key(labels))
@@ -213,7 +253,8 @@ class Histogram(_Metric):
                 acc += c
                 buckets.append(["+Inf" if ub == math.inf else ub, acc])
             out.append({"labels": dict(key), "count": n,
-                        "sum": total, "buckets": buckets})
+                        "sum": total, "buckets": buckets,
+                        "quantiles": self.quantiles(**dict(key))})
         return out
 
 
@@ -277,6 +318,7 @@ class MetricsRegistry:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
+            qlines: List[str] = []
             for s in m.samples():
                 key = _label_key(s["labels"])
                 if m.kind == "histogram":
@@ -289,9 +331,20 @@ class MetricsRegistry:
                                  f"{s['sum']}")
                     lines.append(f"{m.name}_count{format_labels(key)} "
                                  f"{s['count']}")
+                    # derived p50/p95/p99 as a sibling gauge family
+                    # (summary-style quantile label): SLOs readable off
+                    # one scrape, no PromQL histogram_quantile needed
+                    for tag, v in s.get("quantiles", {}).items():
+                        lk = _label_key({**s["labels"],
+                                         "quantile": f"0.{tag[1:]}"})
+                        qlines.append(
+                            f"{m.name}_q{format_labels(lk)} {v}")
                 else:
                     lines.append(
                         f"{m.name}{format_labels(key)} {s['value']}")
+            if qlines:
+                lines.append(f"# TYPE {m.name}_q gauge")
+                lines.extend(qlines)
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
@@ -304,14 +357,20 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 
+# The module-level get-or-create shims forward their caller's name
+# verbatim — THEY are not registration sites, their callers are
+# (PT-METRIC judges the literal-ness of the name where it originates).
 def counter(name: str, help: str = "") -> Counter:
+    # ptpu: lint-ok[PT-METRIC] forwarding shim; callers are the sites
     return REGISTRY.counter(name, help)
 
 
 def gauge(name: str, help: str = "") -> Gauge:
+    # ptpu: lint-ok[PT-METRIC] forwarding shim; callers are the sites
     return REGISTRY.gauge(name, help)
 
 
 def histogram(name: str, help: str = "",
               buckets: Optional[Sequence[float]] = None) -> Histogram:
+    # ptpu: lint-ok[PT-METRIC] forwarding shim; callers are the sites
     return REGISTRY.histogram(name, help, buckets=buckets)

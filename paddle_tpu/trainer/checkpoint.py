@@ -288,20 +288,26 @@ def sweep_retention(save_dir: str, keep: Optional[int] = None) -> List[str]:
     if keep is None or keep <= 0 or not os.path.isdir(save_dir):
         return []
     removed = []
-    # quarantined dirs are capped by the same keep count — recurring
-    # corruption (a bad disk region) must not grow storage unboundedly —
-    # and orphaned temp dirs from preemption-killed saves are reaped
-    corrupt = sorted(d for d in os.listdir(save_dir)
-                     if d.startswith(".corrupt-"))
-    for name in _pass_dirs(save_dir)[:-keep] + corrupt[:-keep] \
-            + _stale_tmp_dirs(save_dir):
-        path = os.path.join(save_dir, name)
-        try:
-            shutil.rmtree(path)
-        except OSError as e:
-            log.warning("retention sweep could not remove %s (%s)", path, e)
-            continue
-        removed.append(path)
+    # ckpt_retention: the one checkpoint phase PR 8 left unspanned — a
+    # retention stall (slow rmtree on a network filesystem) was
+    # invisible in Perfetto between the ckpt_save span and the next step
+    with trace.span("ckpt_retention", keep=keep):
+        # quarantined dirs are capped by the same keep count — recurring
+        # corruption (a bad disk region) must not grow storage
+        # unboundedly — and orphaned temp dirs from preemption-killed
+        # saves are reaped
+        corrupt = sorted(d for d in os.listdir(save_dir)
+                         if d.startswith(".corrupt-"))
+        for name in _pass_dirs(save_dir)[:-keep] + corrupt[:-keep] \
+                + _stale_tmp_dirs(save_dir):
+            path = os.path.join(save_dir, name)
+            try:
+                shutil.rmtree(path)
+            except OSError as e:
+                log.warning("retention sweep could not remove %s (%s)",
+                            path, e)
+                continue
+            removed.append(path)
     if removed:
         counter("ckpt_retention_removed",
                 "checkpoint/quarantine/orphan dirs reaped by the "

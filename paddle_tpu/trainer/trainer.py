@@ -116,6 +116,10 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self.samples_seen = 0
+        # --roofline_dump: first-batch feed retained for the one-shot
+        # compiled-step cost attribution at the end of pass 0
+        self._roofline_feed = None
+        self._roofline_dumped = False
         if FLAGS.init_model_path:
             self.load(FLAGS.init_model_path)
         # static pruning hooks (ParameterUpdaterHook.cpp:39): masks are
@@ -263,8 +267,13 @@ class Trainer:
                 masks = {n: (touched_row_mask(g) if n in sparse_names
                              else None)
                          for n, g in grads.items()}
-            new_params, new_opt = opt.apply(params, grads, opt_state, lr,
-                                            lr_scales, sparse_masks=masks)
+            # named_scope: the update lands in its own "optimizer"
+            # region in the compiled-step cost attribution
+            # (observe/costmodel.py) instead of polluting layer regions
+            with jax.named_scope("optimizer"):
+                new_params, new_opt = opt.apply(params, grads, opt_state,
+                                                lr, lr_scales,
+                                                sparse_masks=masks)
             return new_params, new_opt, new_buffers, loss
 
         self._raw_step = step   # unjitted; benchmarks scan over it
@@ -326,13 +335,14 @@ class Trainer:
                 masks = {n: (touched_row_mask(g) if n in sparse_names
                              else None)
                          for n, g in grads.items()}
-            new_params, new_opt = opt.apply(params, grads, opt_state,
-                                            lr, lr_scales,
-                                            sparse_masks=masks)
-            new_params = ls.select(finite, new_params, params)
-            new_opt = ls.select(finite, new_opt, opt_state)
-            new_buffers = ls.select(finite, new_buffers, buffers)
-            new_ls = ls.update(ls_state, finite, growth_interval)
+            with jax.named_scope("optimizer"):
+                new_params, new_opt = opt.apply(params, grads, opt_state,
+                                                lr, lr_scales,
+                                                sparse_masks=masks)
+                new_params = ls.select(finite, new_params, params)
+                new_opt = ls.select(finite, new_opt, opt_state)
+                new_buffers = ls.select(finite, new_buffers, buffers)
+                new_ls = ls.update(ls_state, finite, growth_interval)
             return new_params, new_opt, new_buffers, loss, new_ls
 
         self._raw_step = step   # unjitted; benchmarks scan over it
@@ -540,6 +550,33 @@ class Trainer:
             ).inc(delta)
             self._skipped_reported = skipped
 
+    def _pass_boundary_observability(self) -> None:
+        """Once-per-pass observability work that must stay OFF the step
+        hot path: HBM gauges (``hbm_in_use_bytes`` / ``hbm_peak_bytes``
+        / category attribution — sampled only when a metrics sink or
+        the ``/metrics`` endpoint is live, so the no-sink path pays one
+        boolean test per pass), and the one-shot ``--roofline_dump``
+        cost-attribution report of the compiled train step."""
+        from ..observe import http as ohttp
+        from ..observe import memory as omem
+
+        if observe.active() or ohttp.serving():
+            omem.sample(self, feed=self._roofline_feed)
+        path = FLAGS.roofline_dump
+        if path and not self._roofline_dumped \
+                and self._roofline_feed is not None:
+            from ..observe import costmodel
+
+            report = costmodel.analyze_trainer_step(
+                self, self._roofline_feed)
+            if report is not None:
+                costmodel.dump_report(report, path)
+                log.info("roofline/cost attribution written to %s "
+                         "(%d regions)", path, len(report["regions"]))
+            self._roofline_dumped = True
+            if not (observe.active() or ohttp.serving()):
+                self._roofline_feed = None   # keep nothing alive
+
     # --------------------------------------------------------- main loops
     def train(self, reader, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
@@ -593,6 +630,9 @@ class Trainer:
                         else:
                             feed = feeder.convert(batch) if feeder \
                                 else batch
+                        if FLAGS.roofline_dump and \
+                                self._roofline_feed is None:
+                            self._roofline_feed = feed
                         loss = self.train_one_batch(
                             feed, placed=pipe is not None)
                         busy_s += time.perf_counter() - t1
@@ -613,6 +653,7 @@ class Trainer:
                     if pipe is not None:
                         pipe.close()
             self._sync_precision_metrics()   # pass boundary: one sync
+            self._pass_boundary_observability()
             if wait_s + busy_s > 0:
                 observe.gauge(
                     "input_bound_ratio",

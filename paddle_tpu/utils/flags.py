@@ -216,6 +216,18 @@ FLAGS.define("debug_dump_signal", False,
              "post-mortem for wedged runs without a debugger")
 FLAGS.define("debug_dump_dir", "/tmp",
              "output directory for --debug_dump_signal dumps")
+FLAGS.define("roofline_dump", "",
+             "write the attributed per-region roofline/cost report of "
+             "the compiled train step (observe/costmodel.py: FLOPs / "
+             "HBM bytes / compute-vs-memory verdict per network layer, "
+             "keyed through the layer named_scopes) to this JSON path "
+             "at the end of the first training pass; empty = off")
+FLAGS.define("roofline_peak_flops", 0.0,
+             "override the detected peak FLOP/s for roofline/MFU "
+             "verdicts (0 = auto-detect from the device kind)")
+FLAGS.define("roofline_peak_gbps", 0.0,
+             "override the detected HBM bandwidth (GB/s) for roofline "
+             "verdicts (0 = auto-detect from the device kind)")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2,
              "async input pipeline depth (data/pipeline.py): max "

@@ -1,0 +1,37 @@
+"""PT-METRIC fixture: the near-miss shapes that must NOT be flagged —
+literal names with variability in labels/attrs, a module-level string
+constant (cardinality one), and same-named functions that are not the
+observe registry."""
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+
+QUEUE_GAUGE = "serve_queue_depth"
+
+
+def tick(kind, step):
+    observe.counter("rnn_dispatch_total").inc(kind=kind)
+    observe.gauge(QUEUE_GAUGE).set(3.0)
+    observe.histogram("serve_infer_seconds").observe(0.01)
+    with trace.span("train_step", step=step):
+        pass
+
+
+def not_the_registry(name):
+    cache = {}
+    counter = cache.get          # a local callable named "counter"
+    return counter(name)
+
+
+def own_span(span, name):
+    return span(name)            # unresolved bare name: not trace.span
+
+
+class OtherTracer:
+    """An unrelated tracer attribute (OpenTelemetry-style): its
+    dynamic span names are not the observe registry's problem."""
+
+    def __init__(self, trace):
+        self.trace = trace
+
+    def handle(self, request_id):
+        return self.trace.span(f"req-{request_id}")

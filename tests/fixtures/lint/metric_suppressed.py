@@ -1,0 +1,17 @@
+"""PT-METRIC fixture: deliberate dynamic names under justified
+pragmas (a fixed-enum name is bounded cardinality by construction)."""
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+
+_PHASES = ("feed", "step_dispatch", "fence")
+
+
+def phase_counter(phase):
+    assert phase in _PHASES
+    # ptpu: lint-ok[PT-METRIC] bounded: phase comes from _PHASES
+    return observe.counter("phase_" + phase)
+
+
+def phase_span(phase):
+    assert phase in _PHASES
+    return trace.span(phase)   # ptpu: lint-ok[PT-METRIC] bounded enum

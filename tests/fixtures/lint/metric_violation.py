@@ -1,0 +1,30 @@
+"""PT-METRIC fixture: dynamic metric/span names at registration
+sites — every class the rule catches, one per line-pinned site."""
+from paddle_tpu import observe
+from paddle_tpu.observe import REGISTRY, trace
+from paddle_tpu.observe.metrics import counter
+
+
+def tick(kind):
+    observe.counter(f"rnn_{kind}_total").inc()           # line 9
+
+
+def measure(op):
+    observe.histogram("latency_" + op).observe(1.0)      # line 13
+
+
+def record(name):
+    counter(name).inc()                                  # line 17
+
+
+def fleet(i):
+    REGISTRY.gauge("queue_depth_%d" % i).set(0.0)        # line 21
+
+
+def spanned(step):
+    with trace.span(f"step_{step}"):                     # line 25
+        pass
+
+
+def echo(op):
+    trace.record_span(str(op), 0.0, 1.0, "t")            # line 30

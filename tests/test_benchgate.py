@@ -1,0 +1,286 @@
+"""Perf-regression gate (ISSUE 10, third leg): the bench trajectory is
+no longer write-only history.
+
+Layers:
+
+1. **Series extraction + gate semantics** (pure stdlib): direction
+   inference, tolerance bands, the 2x-slowed-row trip the ISSUE pins,
+   error rows regressing unconditionally, subset runs skipping, new
+   series staying informative.
+2. **The committed artifact** (`benchmark/baselines/cpu_small.json`):
+   schema-valid, carries the perf-observatory stamp on its lines, and a
+   replay of its own lines through ``bench.py --from_jsonl --baseline
+   ... --check`` exits 0 (report-only contract) while a synthetically
+   2x-slowed row exits nonzero — the tier-1-adjacent CI shape, no
+   multi-minute workload run needed.
+"""
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.observe import REGISTRY, benchgate
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+BASELINE = os.path.join(ROOT, "benchmark", "baselines",
+                        "cpu_small.json")
+
+
+def _bench_main(argv):
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench.main(argv)
+
+
+# ------------------------------------------------------ series extraction
+def test_series_from_simple_line_uses_median_and_direction():
+    s = benchgate.series_from_line({
+        "metric": "lstm_ms_per_batch", "value": 50.0, "median": 48.0,
+        "spread": 0.04, "unit": "ms/batch"})
+    assert s == {"lstm_ms_per_batch": {
+        "value": 48.0, "spread": 0.04, "direction": "lower",
+        "unit": "ms/batch"}}
+
+
+@pytest.mark.parametrize("metric,unit,expect", [
+    ("resnet50_samples_per_sec_per_chip", "samples/sec", "higher"),
+    ("seq2seq_tokens_per_sec", "tokens/sec", "higher"),
+    ("observe_trace_overhead_us_per_step", "us", "lower"),
+    ("input_pipeline_bound_ratio_max", "", "abs"),
+    ("precision_bf16_speedup_2nd_best", "x", "higher"),
+    ("mystery_metric", "ms/call", "lower"),
+])
+def test_direction_inference(metric, unit, expect):
+    s = benchgate.series_from_line(
+        {"metric": metric, "value": 1.0, "unit": unit})
+    assert s[metric]["direction"] == expect
+
+
+def test_series_from_composite_lane_rows():
+    line = {
+        "metric": "pipe", "value": 0.01, "spread": 0.1,
+        "rows": [
+            {"workload": "lstm",
+             "sync": {"ms_per_batch": 10.0},
+             "prefetch": {"ms_per_batch": 8.0}},
+            {"workload": "tform",
+             "fp32": {"ms_per_batch": 4.0},
+             "bf16": {"ms_per_batch": 3.0}},
+        ]}
+    s = benchgate.series_from_line(line)
+    assert s["pipe.lstm.sync_ms"]["value"] == 10.0
+    assert s["pipe.lstm.prefetch_ms"]["value"] == 8.0
+    assert s["pipe.tform.fp32_ms"]["value"] == 4.0
+    assert s["pipe.tform.bf16_ms"]["value"] == 3.0
+    assert all(v["direction"] == "lower" for k, v in s.items()
+               if k != "pipe")
+
+
+def test_error_line_produces_no_series():
+    assert benchgate.series_from_line(
+        {"metric": "x", "error": "boom"}) == {}
+    assert benchgate.series_from_line({"note": "no metric"}) == {}
+
+
+# ------------------------------------------------------------ gate bands
+LINES = [
+    {"metric": "lstm_ms", "median": 100.0, "spread": 0.02,
+     "unit": "ms/batch"},
+    {"metric": "resnet_samples_per_sec", "median": 40.0, "spread": 0.1,
+     "unit": "samples/sec"},
+    {"metric": "input_bound_ratio_max", "median": 0.01, "spread": 0.0,
+     "unit": ""},
+]
+
+
+def test_baseline_document_is_self_describing():
+    doc = benchgate.make_baseline(LINES, meta={"scale": "test"})
+    assert doc["schema"] == benchgate.SCHEMA
+    assert doc["meta"] == {"scale": "test"}
+    s = doc["series"]["lstm_ms"]
+    assert s["direction"] == "lower"
+    # floor dominates a 2% spread; spread-heavy rows widen the band
+    assert s["tolerance"] == benchgate.REL_TOL_FLOOR
+    assert doc["series"]["resnet_samples_per_sec"]["tolerance"] == \
+        pytest.approx(0.5)
+    assert doc["series"]["input_bound_ratio_max"]["tolerance"] == \
+        benchgate.ABS_TOL
+    assert doc["lines"] == LINES
+
+
+def test_gate_passes_identical_run_and_trips_2x_slowdown():
+    doc = benchgate.make_baseline(LINES)
+    assert benchgate.compare(LINES, doc).ok
+    slowed = copy.deepcopy(LINES)
+    slowed[0]["median"] = 200.0              # 2x slower: +100% > 50%
+    res = benchgate.compare(slowed, doc)
+    assert not res.ok
+    assert [r["series"] for r in res.regressions] == ["lstm_ms"]
+    assert res.regressions[0]["worse_by"] == pytest.approx(1.0)
+
+
+def test_gate_direction_awareness():
+    doc = benchgate.make_baseline(LINES)
+    halved = copy.deepcopy(LINES)
+    halved[1]["median"] = 20.0               # throughput halved
+    res = benchgate.compare(halved, doc)
+    assert [r["series"] for r in res.regressions] == \
+        ["resnet_samples_per_sec"]
+    # improvement in the same magnitude never trips
+    better = copy.deepcopy(LINES)
+    better[0]["median"] = 50.0
+    better[1]["median"] = 80.0
+    assert benchgate.compare(better, doc).ok
+
+
+def test_gate_abs_band_for_bounded_ratios():
+    doc = benchgate.make_baseline(LINES)
+    drifted = copy.deepcopy(LINES)
+    drifted[2]["median"] = 0.04              # +0.03 <= 0.05 band
+    assert benchgate.compare(drifted, doc).ok
+    drifted[2]["median"] = 0.09              # +0.08 > 0.05
+    res = benchgate.compare(drifted, doc)
+    assert [r["series"] for r in res.regressions] == \
+        ["input_bound_ratio_max"]
+
+
+def test_gate_survives_zero_and_negative_lower_baselines():
+    """Difference-style 'lower' series (observe lane overhead) can
+    baseline at ~0 or negative: the ratio is undefined/sign-flipped
+    there, but a real blow-up must still trip and a flat run must not
+    crash the --check invocation."""
+    lines = [{"metric": "overhead_us", "median": 0.0, "spread": 0.0,
+              "unit": "us"},
+             {"metric": "neg_overhead_us", "median": -0.5, "spread": 0.0,
+              "unit": "us"}]
+    doc = benchgate.make_baseline(lines)
+    assert benchgate.compare(lines, doc).ok        # self-compare: flat
+    blown = copy.deepcopy(lines)
+    blown[0]["median"] = 500.0
+    blown[1]["median"] = 500.0
+    res = benchgate.compare(blown, doc)
+    assert sorted(r["series"] for r in res.regressions) == \
+        ["neg_overhead_us", "overhead_us"]
+
+
+def test_gate_error_row_regresses_unconditionally():
+    doc = benchgate.make_baseline(LINES)
+    errored = copy.deepcopy(LINES)
+    errored[0] = {"metric": "lstm_ms", "error": "OOM"}
+    res = benchgate.compare(errored, doc)
+    assert not res.ok
+    assert res.errors == ["lstm_ms: OOM"]
+    assert "lstm_ms" in res.skipped          # no series to judge
+
+
+def test_gate_subset_run_skips_and_new_series_inform():
+    doc = benchgate.make_baseline(LINES)
+    subset = [LINES[0],
+              {"metric": "brand_new", "median": 1.0, "unit": "ms"}]
+    res = benchgate.compare(subset, doc)
+    assert res.ok
+    assert sorted(res.skipped) == ["input_bound_ratio_max",
+                                   "resnet_samples_per_sec"]
+    new = next(r for r in res.rows if r["series"] == "brand_new")
+    assert new["baseline"] is None and not new["regressed"]
+
+
+def test_render_table_verdicts():
+    doc = benchgate.make_baseline(LINES)
+    slowed = copy.deepcopy(LINES)
+    slowed[0]["median"] = 300.0
+    txt = benchgate.render_table(benchgate.compare(slowed, doc), "b.json")
+    assert "REGRESSED" in txt and "FAIL" in txt
+    assert "lstm_ms" in txt
+    ok_txt = benchgate.render_table(benchgate.compare(LINES, doc))
+    assert "PASS" in ok_txt and "REGRESSED" not in ok_txt
+
+
+def test_write_and_load_baseline_schema_guard(tmp_path):
+    path = str(tmp_path / "b.json")
+    benchgate.write_baseline(path, LINES, meta={"m": 1})
+    doc = benchgate.load_baseline(path)
+    assert doc["meta"] == {"m": 1}
+    doc["schema"] = 99
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="schema"):
+        benchgate.load_baseline(path)
+
+
+# ------------------------------------------- the committed cpu_small gate
+def _committed():
+    return benchgate.load_baseline(BASELINE)
+
+
+def test_committed_baseline_lines_carry_observatory_stamp():
+    """Acceptance pin: every (non-error) bench line in the committed
+    artifact carries the per-region attribution, the HBM gauges, and
+    the shared-implementation MFU."""
+    doc = _committed()
+    assert doc["series"], "empty baseline"
+    for line in doc["lines"]:
+        assert line.get("regions"), line["metric"]
+        for region in line["regions"]:
+            assert region["bound"] in ("compute", "memory")
+            assert region["flops"] >= 0 and region["bytes"] >= 0
+        assert line["hbm_peak_bytes"] > 0
+        assert line["hbm_in_use_bytes"] > 0
+        assert "params" in line["hbm_categories"]
+        assert line["mfu_est"] >= 0
+        assert line["mfu_source"] in ("costmodel", "analytic-fallback")
+
+
+def test_committed_baseline_check_report_only(tmp_path):
+    """CI shape: replay the artifact's own lines through the gate in
+    report-only mode — always exit 0."""
+    doc = _committed()
+    replay = str(tmp_path / "replay.jsonl")
+    with open(replay, "w") as f:
+        for line in doc["lines"]:
+            f.write(json.dumps(line) + "\n")
+    rc = _bench_main(["--from_jsonl", replay, "--baseline", BASELINE,
+                      "--check", "--check_report_only"])
+    assert rc == 0
+    rc = _bench_main(["--from_jsonl", replay, "--baseline", BASELINE,
+                      "--check"])
+    assert rc == 0           # an unmodified tree passes the hard gate
+
+
+def test_committed_baseline_gate_trips_on_2x_slowed_row(tmp_path):
+    doc = _committed()
+    lines = copy.deepcopy(doc["lines"])
+    slowed_series = []
+    for line in lines:
+        for row in line.get("rows", ()):
+            for mode in ("sync", "prefetch", "fp32", "bf16"):
+                if row.get(mode, {}).get("ms_per_batch"):
+                    row[mode]["ms_per_batch"] *= 2.0
+                    slowed_series.append(
+                        f"{line['metric']}.{row['workload']}.{mode}_ms")
+    assert slowed_series, "committed baseline has no nested timings"
+    replay = str(tmp_path / "slowed.jsonl")
+    with open(replay, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+    before = REGISTRY.counter("bench_regressions_total").total()
+    rc = _bench_main(["--from_jsonl", replay, "--baseline", BASELINE,
+                      "--check"])
+    assert rc == 2
+    after = REGISTRY.counter("bench_regressions_total").total()
+    assert after - before >= len(slowed_series)
+
+
+def test_check_without_baseline_is_an_argparse_error(tmp_path):
+    replay = str(tmp_path / "r.jsonl")
+    with open(replay, "w") as f:
+        f.write(json.dumps(LINES[0]) + "\n")
+    with pytest.raises(SystemExit):
+        _bench_main(["--from_jsonl", replay, "--check"])

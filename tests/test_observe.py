@@ -82,6 +82,61 @@ def test_histogram_time_context():
     assert h.count() == 1 and 0 <= h.sum() < 10
 
 
+def test_histogram_quantile_interpolation():
+    """Prometheus ``histogram_quantile`` semantics: linear interpolation
+    inside the bucket the rank lands in."""
+    h = MetricsRegistry().histogram("lat", buckets=(1.0, 2.0))
+    for _ in range(50):
+        h.observe(0.5)
+    for _ in range(50):
+        h.observe(1.5)
+    assert h.quantile(0.5) == pytest.approx(1.0)   # rank 50 = bucket edge
+    assert h.quantile(0.75) == pytest.approx(1.5)  # halfway into (1, 2]
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    assert h.quantiles() == {
+        "p50": pytest.approx(1.0),
+        "p95": pytest.approx(1.9),
+        "p99": pytest.approx(1.98),
+    }
+
+
+def test_histogram_quantile_overflow_clamps_to_last_finite_bound():
+    """The +Inf bucket has no width to interpolate over — ranks landing
+    there clamp to the last finite bound instead of reporting inf."""
+    h = MetricsRegistry().histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.2, 0.9, 5.0, 7.0, 9.0):
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(1.0)
+    assert h.quantile(0.99) == 1.0          # 3 of 6 live past the bound
+    assert math.isfinite(h.quantiles()["p99"])
+
+
+def test_histogram_quantile_empty_and_labeled_series():
+    h = MetricsRegistry().histogram("lat", buckets=(1.0,))
+    assert h.quantile(0.5) is None and h.quantiles() == {}
+    h.observe(0.25, op="read")
+    assert h.quantile(0.5, op="read") == pytest.approx(0.5)
+    assert h.quantile(0.5) is None           # unlabeled series untouched
+
+
+def test_quantiles_exported_on_samples_and_prometheus_dump():
+    """Satellite: p50/p95/p99 ride every histogram export — the JSONL
+    samples and the ``/metrics`` Prometheus text (``<name>_q`` gauge
+    family with a summary-style ``quantile`` label)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("step_seconds", buckets=(0.1, 1.0))
+    for _ in range(100):
+        h.observe(0.05)
+    s = h.samples()[0]
+    assert set(s["quantiles"]) == {"p50", "p95", "p99"}
+    assert s["quantiles"]["p50"] == pytest.approx(0.05)
+    txt = reg.prometheus_text()
+    assert "# TYPE step_seconds_q gauge" in txt
+    assert 'step_seconds_q{quantile="0.50"}' in txt
+    assert 'step_seconds_q{quantile="0.95"}' in txt
+    assert 'step_seconds_q{quantile="0.99"}' in txt
+
+
 def test_concurrent_increments_from_threads():
     reg = MetricsRegistry()
     c = reg.counter("n")

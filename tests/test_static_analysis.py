@@ -147,6 +147,28 @@ def test_lock_fixture_suppressed_and_clean():
     assert _run_one("lock_clean.py", rules=["PT-LOCK"]).findings == []
 
 
+def test_metric_fixture_catches_every_dynamic_name_class():
+    res = _run_one("metric_violation.py", rules=["PT-METRIC"])
+    assert all(f.rule == "PT-METRIC" for f in res.findings)
+    # f-string counter, concatenated histogram, variable through the
+    # imported shim, %-format on REGISTRY, f-string span, call-result
+    # record_span — one per line-pinned site
+    assert _lines(res, "PT-METRIC") == [9, 13, 17, 21, 25, 30]
+    by_line = {f.line: f.message for f in res.findings}
+    assert "an f-string" in by_line[9]
+    assert "concatenation" in by_line[13]
+    assert "the variable 'name'" in by_line[17]
+    assert by_line[25].startswith("span name")
+    assert "a call result" in by_line[30]
+    assert "labels" in by_line[9] and "span attrs" in by_line[25]
+
+
+def test_metric_fixture_suppressed_and_clean():
+    sup = _run_one("metric_suppressed.py", rules=["PT-METRIC"])
+    assert not sup.findings and len(sup.suppressed) == 2
+    assert _run_one("metric_clean.py", rules=["PT-METRIC"]).findings == []
+
+
 def test_lock_graph_builds_named_edges():
     project, _ = engine.build_project([_fx("lock_clean.py")])
     graph, findings = lock_order.build_lock_graph(project)

@@ -692,3 +692,19 @@ def test_checkpoint_save_and_verify_spans(tmp_path):
     (save_e,) = _by_name(evs, "ckpt_save")
     assert _args(save_e)["pass_id"] == 0
     assert _by_name(evs, "ckpt_verify")
+
+
+def test_checkpoint_retention_span(tmp_path):
+    """ISSUE 10 satellite: the retention sweep — the one checkpoint
+    phase PR 8 left unspanned — now lands in Perfetto, so a slow
+    rmtree on a network filesystem is attributable."""
+    from paddle_tpu.trainer.checkpoint import save_checkpoint, \
+        sweep_retention
+
+    for p in range(3):
+        save_checkpoint(str(tmp_path), p, {"w": np.ones((2, 2)) * p})
+    trace.enable(ring_size=64)
+    removed = sweep_retention(str(tmp_path), keep=1)
+    assert len(removed) == 2
+    (ret_e,) = _by_name(trace.events(), "ckpt_retention")
+    assert _args(ret_e)["keep"] == 1
