@@ -68,6 +68,13 @@ accessed" — deltas across ``--conv_bn_fuse_fwd`` on/off track the
 forward-fusion traffic cut without an xprof session), and ``--profile``
 dumps a per-workload ``jax.profiler`` trace (path on the JSON line as
 ``trace_dir``).
+
+Round 16 adds ``--attribution_diff OLD NEW``: a pure-host replay mode
+that diffs two ``--roofline_dump`` reports per region (FLOPs / HBM
+bytes / roofline verdict / MFU / bwd_frac, with add/remove/rename
+detection — ``observe/costmodel.attribution_diff``) and emits the
+machine-readable delta ``--check`` gates on — every kernel PR ships
+verified before/after attribution.
 """
 
 import argparse
@@ -183,17 +190,17 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
     # --precision=bf16 trainers thread the loss-scale state through the
     # step; carry it in the scan so the timed program is the production
     # mixed-precision step (finite-check, select, scale update included)
-    mixed = getattr(trainer, "_ls_state", None) is not None
-
+    # --precision=bf16 threads the loss-scale state through the step
+    # and --health_interval threads the health accumulator; carry both
+    # in the scan so the timed program is the production step.  Every
+    # step variant returns (params, opt, buffers, loss, *extras) with
+    # the extras mirroring the trailing inputs (Trainer._step_extras,
+    # the one definition of the order), so carry plumbing is uniform:
+    # out[:3] + out[4:].
     def k_steps(k):
         def body(carry, _):
-            if mixed:
-                p, o, b, s = carry
-                p, o, b, loss, s = raw(p, o, b, sfeed, rng, progress, s)
-                return (p, o, b, s), loss
-            p, o, b = carry
-            p, o, b, loss = raw(p, o, b, sfeed, rng, progress)
-            return (p, o, b), loss
+            out = raw(*carry[:3], sfeed, rng, progress, *carry[3:])
+            return (out[:3] + out[4:]), out[3]
 
         @_partial(jax.jit, donate_argnums=(0,))
         def run(carry):
@@ -202,9 +209,8 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
         return run
 
     def snapshot():
-        state = (trainer.params, trainer.opt_state, trainer.buffers)
-        if mixed:
-            state += (trainer._ls_state,)
+        state = (trainer.params, trainer.opt_state, trainer.buffers) \
+            + trainer._step_extras()
         return jax.tree_util.tree_map(lambda x: x.copy(), state)
 
     def samples(run, n=3, drop_first=True):
@@ -224,8 +230,7 @@ def _scan_time_ms(trainer, feed, iters=256, max_tries=3, tol=0.2):
         def one(carry):
             out = trainer._train_step(*carry[:3], sfeed, rng, progress,
                                       *carry[3:])
-            state = (out[:3] + out[4:]) if mixed else out[:3]
-            return state, out[3]
+            return out[:3] + out[4:], out[3]
         return min(samples(one, drop_first=False))
 
     one = one_step_time()
@@ -1250,6 +1255,22 @@ def main(argv=None):
                          "instead of executing workloads — re-gate an "
                          "old artifact (BENCH_r*.json tail) without a "
                          "multi-minute run")
+    # ---- attribution diff (observe/costmodel.py): machine-checked
+    # before/after roofline attribution for kernel PRs
+    ap.add_argument("--attribution_diff", nargs=2, default=None,
+                    metavar=("OLD", "NEW"),
+                    help="diff two --roofline_dump reports per region "
+                         "(FLOPs, HBM bytes, roofline verdict, MFU, "
+                         "bwd_frac; add/remove/rename detection): "
+                         "machine-readable JSON delta on stdout, human "
+                         "table on stderr; with --check, exit 2 when "
+                         "any region's bytes or time estimate "
+                         "regressed beyond --attribution_tolerance")
+    ap.add_argument("--attribution_tolerance", type=float, default=0.05,
+                    help="fractional growth in a region's HBM bytes or "
+                         "time estimate (or the step totals) that "
+                         "counts as a regression for --attribution_diff "
+                         "--check (default 0.05)")
     # framework flags ride the same CLI (e.g. --fused_rnn_hblock=false
     # for an A/B of the blocked RNN tier against the scan path, or
     # --metrics_jsonl/--log_level for the telemetry satellites)
@@ -1268,6 +1289,20 @@ def main(argv=None):
     if args.precision_small:
         global PRECISION_SMALL
         PRECISION_SMALL = True
+    if args.attribution_diff:
+        # pure-host replay of two committed dumps: no workload runs, no
+        # backend touched — the kernel-PR verification loop stays fast
+        old = costmodel.load_report(args.attribution_diff[0])
+        new = costmodel.load_report(args.attribution_diff[1])
+        diff = costmodel.attribution_diff(
+            old, new, tolerance=args.attribution_tolerance)
+        print(json.dumps(diff), flush=True)
+        print(costmodel.render_diff_table(diff), file=sys.stderr,
+              flush=True)
+        if (args.check and not args.check_report_only
+                and not diff["ok"]):
+            return 2
+        return 0
     if (args.check or args.check_report_only) and not args.baseline:
         ap.error("--check requires --baseline FILE")
 

@@ -252,6 +252,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     tp.add_argument("--debug_dump_signal", action="store_true",
                     help="SIGUSR2 dumps metrics + flight-recorder "
                          "trace of the live run to --debug_dump_dir")
+    tp.add_argument("--health_interval", type=int, default=None,
+                    help="training-health telemetry: drain per-layer "
+                         "grad/param/update-ratio accumulators and run "
+                         "the divergence/non-finite detectors every N "
+                         "steps (served on /metrics, /health and "
+                         "/healthz; 0 = off, the byte-for-byte legacy "
+                         "step)")
     tp.set_defaults(fn=cmd_train)
 
     mp = sub.add_parser(
@@ -327,6 +334,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         FLAGS.set("metrics_port", args.metrics_port)
     if getattr(args, "debug_dump_signal", False):
         FLAGS.set("debug_dump_signal", True)
+    if getattr(args, "health_interval", None) is not None:
+        FLAGS.set("health_interval", args.health_interval)
     # umbrella: --metrics_jsonl reporter, --trace_jsonl span sink,
     # --metrics_port endpoint, --debug_dump_signal handler — each a
     # no-op when its flag is unset (no thread starts)

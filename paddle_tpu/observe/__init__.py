@@ -20,7 +20,11 @@ Instrumented surfaces (all against :data:`REGISTRY`):
 - fault tolerance: master reconnect/backoff/replay, checkpoint
   save/verify latency + quarantines, elastic skipped-save/election
   releases (``distributed/``, ``trainer/checkpoint.py``);
-- serving: request count + inference latency (``serving/loader.py``).
+- serving: request count + inference latency (``serving/loader.py``);
+- training health: per-layer grad/param norms, update ratios,
+  non-finite localization and detector alerts, drained from the
+  on-device accumulators every ``--health_interval`` steps
+  (``observe/health.py``, ``trainer/trainer.py``).
 
 Overhead contract: with no sink attached every instrument is a dict
 lookup + lock + add; anything more expensive (step fencing) is gated on
@@ -48,10 +52,12 @@ from .report import (  # noqa: F401
 from .report import start_from_flags as _start_reporter_from_flags
 from .report import stop_global as _stop_reporter_global
 from . import benchgate, dump, http, memory, trace  # noqa: F401
-# costmodel is NOT imported eagerly: its analysis entry points touch
-# jax (lazily), and keeping it an explicit `from paddle_tpu.observe
-# import costmodel` preserves this package's import-time zero-dep rule
-# exactly as before.
+# costmodel and health are NOT imported eagerly: their entry points
+# touch jax (lazily), and keeping them explicit `from
+# paddle_tpu.observe import costmodel` / `... import health` imports
+# preserves this package's import-time zero-dep rule — AND lets the
+# HTTP endpoint / healthz probe resolve them through sys.modules so a
+# process that never trained pays nothing for either surface.
 
 
 def start_from_flags():

@@ -627,16 +627,15 @@ def mfu(flops_per_step: float, seconds_per_step: float,
 # ----------------------------------------------------- trainer analysis
 def _step_args(trainer, feed):
     """The train step's argument tuple, exactly as ``train_one_batch``
-    dispatches it (loss-scale state appended under --precision=bf16)."""
+    dispatches it (loss-scale state appended under --precision=bf16,
+    the health accumulator appended under --health_interval > 0)."""
     import jax
     import jax.numpy as jnp
 
     sfeed = trainer._shard_feed(feed)
-    args = (trainer.params, trainer.opt_state, trainer.buffers, sfeed,
-            jax.random.PRNGKey(0), jnp.zeros((), jnp.float32))
-    if getattr(trainer, "_ls_state", None) is not None:
-        args += (trainer._ls_state,)
-    return args
+    return (trainer.params, trainer.opt_state, trainer.buffers, sfeed,
+            jax.random.PRNGKey(0), jnp.zeros((), jnp.float32)) \
+        + trainer._step_extras()
 
 
 def _known_regions(network) -> frozenset:
@@ -647,10 +646,29 @@ def _known_regions(network) -> frozenset:
     for gname, grp in getattr(network, "groups", {}).items():
         names.update(f"{n}.{gname}" for n in grp.layers)
     names.add("optimizer")
+    # the --health_interval aux path scopes as its own region so its
+    # (small) reduction cost is attributed, not smeared over layers
+    names.add("health")
     return frozenset(names)
 
 
 _ANALYSIS_CACHE: Dict[str, Dict[str, Any]] = {}
+
+#: Version stamped on every report this module emits.  v1 = the PR-10
+#: unversioned dump; v2 adds ``schema`` + optional ``mfu_est`` and is
+#: the first version ``attribution_diff`` treats as its own.  Bump on
+#: any region-row field change so two dumps are comparable by machine.
+SCHEMA_VERSION = 2
+
+# most recent report produced in this process — the /roofline endpoint
+# body (observe/http.py reads it lazily at scrape time)
+_latest_report: Optional[Dict[str, Any]] = None
+
+
+def latest_report() -> Optional[Dict[str, Any]]:
+    """The most recent :func:`analyze_trainer_step` report (None before
+    the first analysis)."""
+    return _latest_report
 
 
 def analyze_trainer_step(trainer, feed, top: int = 12,
@@ -668,8 +686,10 @@ def analyze_trainer_step(trainer, feed, top: int = 12,
     a crash.  ``cache_key`` memoizes per workload: the report is a
     property of the lowering, identical across timing attempts.
     """
+    global _latest_report
     if cache_key is not None and cache_key in _ANALYSIS_CACHE:
-        return _ANALYSIS_CACHE[cache_key]
+        _latest_report = _ANALYSIS_CACHE[cache_key]
+        return _latest_report
     try:
         # build+compile the step only if the trainer has never stepped:
         # at a pass boundary (--roofline_dump) the step exists, and
@@ -719,6 +739,7 @@ def analyze_trainer_step(trainer, feed, top: int = 12,
         r["share"] = round(r["time_est_s"] / total_time_est, 3)
         r["time_est_s"] = float(f"{r['time_est_s']:.4g}")
     out = {
+        "schema": SCHEMA_VERSION,
         "regions": rows[:top],
         "regions_elided": max(len(rows) - top, 0),
         "flops_per_step": report["flops_per_step"],
@@ -738,6 +759,7 @@ def analyze_trainer_step(trainer, feed, top: int = 12,
     }
     if cache_key is not None:
         _ANALYSIS_CACHE[cache_key] = out
+    _latest_report = out
     return out
 
 
@@ -792,6 +814,203 @@ def render_table(report: Dict[str, Any]) -> str:
 
 def dump_report(report: Dict[str, Any], path: str) -> None:
     """Write a cost report as JSON (the ``--roofline_dump`` artifact)."""
+    report.setdefault("schema", SCHEMA_VERSION)
     with open(path, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")
+
+
+# ----------------------------------------------------- attribution diff
+def load_report(path: str) -> Dict[str, Any]:
+    """Read a ``--roofline_dump`` artifact; unversioned (pre-v2) dumps
+    are stamped ``schema: 1`` so the diff can say what it compared."""
+    with open(path) as f:
+        report = json.load(f)
+    if not isinstance(report, dict) or "regions" not in report:
+        raise ValueError(
+            f"{path!r} is not a roofline/cost report (no 'regions')")
+    report.setdefault("schema", 1)
+    return report
+
+
+def _region_rows(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    return {r["region"]: r for r in report.get("regions") or []}
+
+
+def _frac(old: float, new: float) -> Optional[float]:
+    """(new - old) / |old| — None when the base is zero (a fraction of
+    nothing is noise, the absolute delta field still tells the story)."""
+    if not old:
+        return None
+    return round((new - old) / abs(old), 4)
+
+
+def _match_renames(removed: Dict[str, Dict[str, Any]],
+                   added: Dict[str, Dict[str, Any]],
+                   rtol: float = 0.02) -> Dict[str, str]:
+    """``{added name: removed name}`` for region pairs whose FLOPs AND
+    bytes agree within ``rtol`` — a layer rename (or a named_scope
+    re-label) rather than a genuine add+remove.  A pair is claimed
+    only when the match is unique in BOTH directions: an added region
+    with two removal candidates, or a removed region two added regions
+    could stand in for, stays an honest add/remove — a wrong rename
+    claim is worse than no claim."""
+    hits: Dict[str, List[str]] = {}      # added -> matching removed
+    claims: Dict[str, List[str]] = {}    # removed -> claiming added
+    for aname, arow in added.items():
+        for rname, rrow in removed.items():
+            fo, fn = rrow.get("flops", 0.0), arow.get("flops", 0.0)
+            bo, bn = rrow.get("bytes", 0.0), arow.get("bytes", 0.0)
+            if abs(fn - fo) <= rtol * max(abs(fo), 1.0) \
+                    and abs(bn - bo) <= rtol * max(abs(bo), 1.0):
+                hits.setdefault(aname, []).append(rname)
+                claims.setdefault(rname, []).append(aname)
+    return {aname: rnames[0] for aname, rnames in hits.items()
+            if len(rnames) == 1 and len(claims[rnames[0]]) == 1}
+
+
+#: Per-region numeric fields the diff reports (field, fraction-worthy).
+_DIFF_FIELDS = ("flops", "bytes", "intensity", "time_est_s", "share",
+                "bwd_frac")
+
+
+def attribution_diff(old: Dict[str, Any], new: Dict[str, Any],
+                     tolerance: float = 0.05) -> Dict[str, Any]:
+    """Machine-readable per-region delta between two roofline reports
+    — the ``bench.py --attribution_diff OLD NEW`` payload, closing the
+    loop on attribution-driven kernel work: a PR's before/after claim
+    is verified by machine, not prose.
+
+    Region rows carry ``status`` (``common | added | removed |
+    renamed``), per-field ``*_old / *_new / *_delta / *_delta_frac``,
+    and the roofline ``bound`` verdict transition.  ``regressions``
+    lists common/renamed regions whose HBM ``bytes`` or ``time_est_s``
+    grew beyond ``tolerance`` (fractional) plus total
+    flops/bytes-per-step growth; ``ok`` is False iff any exist —
+    ``--check`` gates on it."""
+    o_rows, n_rows = _region_rows(old), _region_rows(new)
+    removed = {k: v for k, v in o_rows.items() if k not in n_rows}
+    added = {k: v for k, v in n_rows.items() if k not in o_rows}
+    renames = _match_renames(removed, added)
+
+    regions: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+
+    def diff_row(name: str, orow: Dict[str, Any], nrow: Dict[str, Any],
+                 status: str, renamed_from: Optional[str] = None
+                 ) -> Dict[str, Any]:
+        row: Dict[str, Any] = {"region": name, "status": status}
+        if renamed_from:
+            row["renamed_from"] = renamed_from
+        for f in _DIFF_FIELDS:
+            ov = float(orow.get(f, 0.0) or 0.0)
+            nv = float(nrow.get(f, 0.0) or 0.0)
+            row[f + "_old"] = ov
+            row[f + "_new"] = nv
+            row[f + "_delta"] = round(nv - ov, 6)
+            row[f + "_delta_frac"] = _frac(ov, nv)
+        row["bound_old"] = orow.get("bound")
+        row["bound_new"] = nrow.get("bound")
+        row["bound_changed"] = row["bound_old"] != row["bound_new"]
+        for f in ("bytes", "time_est_s"):
+            frac = row[f + "_delta_frac"]
+            if frac is None:
+                continue
+            entry = {"region": name, "field": f,
+                     "old": row[f + "_old"], "new": row[f + "_new"],
+                     "delta_frac": frac}
+            if frac > tolerance:
+                regressions.append(entry)
+            elif frac < -tolerance:
+                improvements.append(entry)
+        return row
+
+    for name in sorted(set(o_rows) & set(n_rows)):
+        regions.append(diff_row(name, o_rows[name], n_rows[name],
+                                "common"))
+    for aname, rname in sorted(renames.items()):
+        regions.append(diff_row(aname, o_rows[rname], n_rows[aname],
+                                "renamed", renamed_from=rname))
+    zero = {f: 0.0 for f in _DIFF_FIELDS}
+    for name in sorted(added):
+        if name in renames:
+            continue
+        regions.append(diff_row(name, zero, n_rows[name], "added"))
+    for name in sorted(removed):
+        if name in renames.values():
+            continue
+        regions.append(diff_row(name, o_rows[name], zero, "removed"))
+
+    totals: Dict[str, Any] = {}
+    for f in ("flops_per_step", "bytes_per_step"):
+        ov = float(old.get(f, 0.0) or 0.0)
+        nv = float(new.get(f, 0.0) or 0.0)
+        totals[f + "_old"] = ov
+        totals[f + "_new"] = nv
+        totals[f + "_delta_frac"] = _frac(ov, nv)
+        frac = totals[f + "_delta_frac"]
+        if frac is not None and frac > tolerance:
+            regressions.append({"region": "_total", "field": f,
+                                "old": ov, "new": nv,
+                                "delta_frac": frac})
+        elif frac is not None and frac < -tolerance:
+            improvements.append({"region": "_total", "field": f,
+                                 "old": ov, "new": nv,
+                                 "delta_frac": frac})
+    for f in ("mfu_est",):
+        if old.get(f) is not None or new.get(f) is not None:
+            totals[f + "_old"] = old.get(f)
+            totals[f + "_new"] = new.get(f)
+            if old.get(f) and new.get(f):
+                totals[f + "_delta_frac"] = _frac(float(old[f]),
+                                                  float(new[f]))
+
+    return {
+        "kind": "attribution_diff",
+        "schema": {"old": old.get("schema", 1),
+                   "new": new.get("schema", 1),
+                   "diff": SCHEMA_VERSION},
+        "tolerance": tolerance,
+        "regions": regions,
+        "totals": totals,
+        "added": sorted(n for n in added if n not in renames),
+        "removed": sorted(r for r in removed
+                          if r not in renames.values()),
+        "renamed": {a: r for a, r in sorted(renames.items())},
+        "regressions": regressions,
+        "improvements": improvements,
+        "ok": not regressions,
+    }
+
+
+def render_diff_table(diff: Dict[str, Any]) -> str:
+    """Human-readable attribution diff (stderr companion of the JSON
+    payload; PERF_NOTES material)."""
+    lines = [f"{'region':<28} {'status':>8} {'GFLOPs Δ%':>10} "
+             f"{'HBM Δ%':>8} {'t_est Δ%':>9} {'bound':>18}"]
+
+    def pct(v: Optional[float]) -> str:
+        return f"{v * 100:+.1f}%" if v is not None else "n/a"
+
+    for r in diff.get("regions", []):
+        bound = (r.get("bound_old") or "?")
+        if r.get("bound_changed"):
+            bound = f"{bound}->{r.get('bound_new') or '?'}"
+        name = r["region"]
+        if r.get("renamed_from"):
+            name = f"{r['renamed_from']}->{name}"
+        lines.append(
+            f"{name:<28} {r['status']:>8} "
+            f"{pct(r.get('flops_delta_frac')):>10} "
+            f"{pct(r.get('bytes_delta_frac')):>8} "
+            f"{pct(r.get('time_est_s_delta_frac')):>9} {bound:>18}")
+    t = diff.get("totals", {})
+    lines.append(
+        "totals: flops/step "
+        f"{pct(t.get('flops_per_step_delta_frac'))}, bytes/step "
+        f"{pct(t.get('bytes_per_step_delta_frac'))}; "
+        f"{len(diff.get('regressions', []))} regression(s), "
+        f"{len(diff.get('improvements', []))} improvement(s), "
+        f"ok={diff.get('ok')}")
+    return "\n".join(lines)

@@ -20,8 +20,10 @@ dispositions by default.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
+import sys
 import threading
 import time
 from typing import Optional, Tuple
@@ -35,9 +37,13 @@ _install_lock = named_lock("observe.dump.install")
 
 
 def debug_dump(out_dir: Optional[str] = None) -> Tuple[str, str]:
-    """Write the two dump files now; returns their paths.  Usable
-    directly (tests, a REPL on a live run) — the signal handler is just
-    this plus plumbing."""
+    """Write the dump files now; returns the (metrics, trace) paths.
+    Usable directly (tests, a REPL on a live run) — the signal handler
+    is just this plus plumbing.  When the training-health observatory
+    has drained at least once this process, its latest structured
+    report is dumped alongside as ``.health.json`` (resolved through
+    ``sys.modules`` — a run that never enabled ``--health_interval``
+    writes exactly the legacy two files)."""
     from ..utils import FLAGS
 
     out_dir = out_dir or FLAGS.get("debug_dump_dir") or "/tmp"
@@ -51,6 +57,12 @@ def debug_dump(out_dir: Optional[str] = None) -> Tuple[str, str]:
         f.write(prometheus_dump())
     with open(trace_path, "w") as f:
         f.write(trace.flight_recorder_json())
+    hmod = sys.modules.get("paddle_tpu.observe.health")
+    health_report = hmod.latest_report() if hmod is not None else None
+    if health_report is not None:
+        with open(stem + ".health.json", "w") as f:
+            json.dump({"report": health_report,
+                       "summary": hmod.status_summary()}, f, indent=1)
     return prom_path, trace_path
 
 

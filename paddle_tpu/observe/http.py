@@ -1,4 +1,5 @@
-"""Live observability endpoint: ``/metrics`` + ``/healthz`` + ``/trace``.
+"""Live observability endpoint: ``/metrics`` + ``/healthz`` +
+``/trace`` + ``/roofline`` + ``/health``.
 
 A stdlib ``http.server`` thread (name ``ptpu-metrics-http``; the
 conftest thread-leak guard keys on it) behind ``--metrics_port`` makes
@@ -7,10 +8,24 @@ a live run scrapeable without the JSONL sinks:
 - ``GET /metrics``  — Prometheus exposition text: the typed registry +
   the ``StatSet`` timer table (:func:`paddle_tpu.observe.prometheus_dump`);
 - ``GET /healthz``  — liveness JSON (``{"status": "ok", ...}`` with pid
-  and uptime), for load-balancer / k8s probes;
+  and uptime), for load-balancer / k8s probes; when the training-health
+  observatory is live its digest rides along (``status`` degrades to
+  ``"degraded"`` on standing alerts — degraded-but-ALIVE: the code
+  stays 200, a health alert must never convince an orchestrator to
+  kill a recoverable run);
 - ``GET /trace``    — the flight recorder as a Chrome trace-event JSON
   array, loadable directly in Perfetto — "what were the last N spans of
-  this live run" without attaching a debugger.
+  this live run" without attaching a debugger;
+- ``GET /roofline`` — the most recent per-region roofline/cost report
+  of this process (``observe/costmodel.py``), JSON;
+- ``GET /health``   — the most recent drained training-health report
+  (``observe/health.py``): per-layer grad/param norms, update ratios,
+  non-finite localization, recent alerts — detail beyond ``/healthz``.
+
+``/roofline`` and ``/health`` follow the ``/trace`` lazy discipline:
+they read module state that only exists once the producing subsystem
+ran (imports resolved at request time through ``sys.modules``), so a
+``/metrics``-only run never imports — let alone pays for — either.
 
 Zero-dependency rule: nothing here imports jax.  Starting the server
 does NOT enable tracing: the first ``/trace`` request flips on
@@ -31,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -62,13 +78,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, prometheus_dump(),
                            "text/plain; version=0.0.4")
             elif path == "/healthz":
-                self._send(200, json.dumps({
+                payload = {
                     "status": "ok", "pid": os.getpid(),
                     "uptime_s": round(
                         time.monotonic() - self.server.t0, 3),
                     "trace_enabled": trace.enabled(),
                     "trace_spans_dropped": trace.dropped_count(),
-                }), "application/json")
+                }
+                # the sys.modules probe keeps the legacy-probe path
+                # byte-identical when the health observatory never ran
+                # this process (nothing imported, nothing computed)
+                hmod = sys.modules.get("paddle_tpu.observe.health")
+                if hmod is not None:
+                    payload["health"] = hmod.status_summary()
+                    # degraded-but-ALIVE: detail degrades, the HTTP
+                    # code stays 200 — never invite a kill
+                    payload["status"] = payload["health"]["status"]
+                self._send(200, json.dumps(payload), "application/json")
             elif path == "/trace":
                 # lazy opt-in: the FIRST /trace request enables
                 # ring-only recording — fence-free (trace.fences_steps
@@ -79,10 +105,35 @@ class _Handler(BaseHTTPRequestHandler):
                 trace.ensure_ring()
                 self._send(200, trace.flight_recorder_json(),
                            "application/json")
+            elif path == "/roofline":
+                cmod = sys.modules.get("paddle_tpu.observe.costmodel")
+                report = cmod.latest_report() if cmod is not None \
+                    else None
+                if report is None:
+                    self._send(404, json.dumps(
+                        {"error": "no roofline report yet (run a "
+                                  "--roofline_dump pass or a bench "
+                                  "lane first)"}), "application/json")
+                else:
+                    self._send(200, json.dumps(report),
+                               "application/json")
+            elif path == "/health":
+                hmod = sys.modules.get("paddle_tpu.observe.health")
+                report = hmod.latest_report() if hmod is not None \
+                    else None
+                if report is None:
+                    self._send(404, json.dumps(
+                        {"error": "no training-health report yet "
+                                  "(enable --health_interval N)"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(report),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path",
-                     "paths": ["/metrics", "/healthz", "/trace"]}),
+                     "paths": ["/metrics", "/healthz", "/trace",
+                               "/roofline", "/health"]}),
                     "application/json")
         except BrokenPipeError:      # scraper hung up mid-response
             pass
@@ -100,7 +151,8 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ObservabilityServer:
-    """The ``/metrics`` + ``/healthz`` + ``/trace`` server thread."""
+    """The ``/metrics`` + ``/healthz`` + ``/trace`` + ``/roofline`` +
+    ``/health`` server thread."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
@@ -162,7 +214,8 @@ def start_from_flags() -> Optional[ObservabilityServer]:
                 return None
             get_logger("observe").info(
                 "observability endpoint on http://127.0.0.1:%d "
-                "(/metrics /healthz /trace)", _global.port)
+                "(/metrics /healthz /trace /roofline /health)",
+                _global.port)
     return _global
 
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import random
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -138,21 +139,43 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+#: Default bound on raw samples a histogram series retains for the
+#: exact-quantile reservoir — the retention cap that keeps a
+#: million-observation training run at constant memory per series.
+DEFAULT_SAMPLE_CAP = 2048
+
+
 class Histogram(_Metric):
     """Fixed-bucket histogram (Prometheus ``le`` convention: a bucket
-    counts observations ``<= upper_bound``; ``+Inf`` is implicit)."""
+    counts observations ``<= upper_bound``; ``+Inf`` is implicit).
+
+    Beyond the buckets, each label series keeps a BOUNDED uniform
+    reservoir of raw observations (Vitter's Algorithm R, cap
+    ``sample_cap``, default :data:`DEFAULT_SAMPLE_CAP`, 0 disables):
+    :meth:`sample_quantile` reads quantiles from it at sample
+    resolution — exact while the series is under the cap, an unbiased
+    uniform-subsample estimate past it — where :meth:`quantile` is
+    limited to bucket-interpolation resolution.  Retention never grows
+    past the cap no matter how long the run observes."""
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Optional[Sequence[float]] = None):
+                 buckets: Optional[Sequence[float]] = None,
+                 sample_cap: Optional[int] = None):
         super().__init__(name, help)
         bs = tuple(sorted(buckets if buckets is not None
                           else DEFAULT_BUCKETS))
         if not bs:
             raise ValueError(f"histogram {self.name!r}: needs >= 1 bucket")
         self.buckets = bs
-        # per label set: [per-bucket counts + overflow, sum, count]
+        self.sample_cap = DEFAULT_SAMPLE_CAP if sample_cap is None \
+            else max(0, int(sample_cap))
+        # reservoir replacement draws need no crypto strength; a
+        # name-derived seed keeps runs reproducible
+        self._rng = random.Random(name)
+        # per label set: [per-bucket counts + overflow, sum, count,
+        #                 bounded sample reservoir]
         self._series: Dict[LabelKey, List[Any]] = {}
 
     def observe(self, value: float, **labels) -> None:
@@ -162,8 +185,8 @@ class Histogram(_Metric):
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = [[0] * (len(self.buckets) + 1),
-                                         0.0, 0]
-            counts, _, _ = s
+                                         0.0, 0, []]
+            counts = s[0]
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     counts[i] += 1
@@ -172,6 +195,16 @@ class Histogram(_Metric):
                 counts[-1] += 1
             s[1] += value
             s[2] += 1
+            if self.sample_cap:
+                res = s[3]
+                if len(res) < self.sample_cap:
+                    res.append(value)
+                else:
+                    # Algorithm R: keep each of the n observations so
+                    # far with equal probability cap/n
+                    j = self._rng.randrange(s[2])
+                    if j < self.sample_cap:
+                        res[j] = value
 
     @contextlib.contextmanager
     def time(self, **labels) -> Iterator[None]:
@@ -225,6 +258,31 @@ class Histogram(_Metric):
             if v is not None:
                 out[f"p{int(q * 100)}"] = v
         return out
+
+    def sample_quantile(self, q: float, **labels) -> Optional[float]:
+        """``q``-quantile from the bounded raw-sample reservoir: exact
+        while the series has observed <= ``sample_cap`` values, an
+        unbiased uniform-subsample estimate beyond (linear
+        interpolation between order statistics).  None with no retained
+        samples (empty series or ``sample_cap=0``) — callers fall back
+        to the bucket-resolution :meth:`quantile`."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            res = list(s[3]) if s else []
+        if not res:
+            return None
+        res.sort()
+        pos = min(max(q, 0.0), 1.0) * (len(res) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(res) - 1)
+        return res[lo] + (res[hi] - res[lo]) * (pos - lo)
+
+    def retained_samples(self, **labels) -> int:
+        """Raw observations currently held in the reservoir for this
+        series — bounded by ``sample_cap`` by construction."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return len(s[3]) if s else 0
 
     def sum(self, **labels) -> float:
         with self._lock:
@@ -287,8 +345,10 @@ class MetricsRegistry:
         return self._get(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
-        return self._get(Histogram, name, help, buckets=buckets)
+                  buckets: Optional[Sequence[float]] = None,
+                  sample_cap: Optional[int] = None) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets,
+                         sample_cap=sample_cap)
 
     def metrics(self) -> List[_Metric]:
         with self._lock:
@@ -371,6 +431,8 @@ def gauge(name: str, help: str = "") -> Gauge:
 
 
 def histogram(name: str, help: str = "",
-              buckets: Optional[Sequence[float]] = None) -> Histogram:
+              buckets: Optional[Sequence[float]] = None,
+              sample_cap: Optional[int] = None) -> Histogram:
     # ptpu: lint-ok[PT-METRIC] forwarding shim; callers are the sites
-    return REGISTRY.histogram(name, help, buckets=buckets)
+    return REGISTRY.histogram(name, help, buckets=buckets,
+                              sample_cap=sample_cap)

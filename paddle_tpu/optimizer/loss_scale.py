@@ -70,6 +70,32 @@ def all_finite(grads: Any) -> jax.Array:
     return finite
 
 
+def leaf_nonfinite_counts(grads: Any) -> Any:
+    """Per-leaf inf/nan element counts (i32 scalars; non-float leaves
+    count 0), same pytree structure as ``grads``.  The skip-step
+    disambiguation primitive: the training-health aux path
+    (``observe/health.py``) aggregates these per layer while
+    :func:`all_finite_from_counts` derives the skip decision from the
+    SAME pass — one ``isfinite`` sweep serves both, and a non-finite
+    the loss scaler skipped is distinguishable from one it let through.
+    """
+    def count(g):
+        if not jnp.issubdtype(jnp.result_type(g), jnp.floating):
+            return jnp.zeros((), jnp.int32)
+        return jnp.sum((~jnp.isfinite(g)).astype(jnp.int32))
+
+    return jax.tree_util.tree_map(count, grads)
+
+
+def all_finite_from_counts(counts: Any) -> jax.Array:
+    """Scalar bool from :func:`leaf_nonfinite_counts` output —
+    equivalent to :func:`all_finite` without a second isfinite pass."""
+    total = jnp.zeros((), jnp.int32)
+    for c in jax.tree_util.tree_leaves(counts):
+        total = total + c
+    return total == 0
+
+
 def unscale(grads: Any, scale: jax.Array) -> Any:
     """Gradients / scale, accumulated in fp32 (master-grad dtype)."""
     inv = (1.0 / scale).astype(jnp.float32)

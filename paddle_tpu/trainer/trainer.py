@@ -108,6 +108,16 @@ class Trainer:
         self._ls_state = ls.init_state() \
             if self.precision == "bf16" else None
         self._skipped_reported = 0
+        # --health_interval N > 0: fuse the per-layer grad/param/update
+        # telemetry aux into the train step (observe/health.py) and
+        # drain every N steps.  At the default 0 the session is None
+        # and every step builder/dispatch below takes its legacy
+        # branch byte-for-byte.
+        self._health = None
+        if int(FLAGS.health_interval) > 0:
+            from ..observe.health import HealthSession
+            self._health = HealthSession(network,
+                                         int(FLAGS.health_interval))
         _LIVE_TRAINERS.add(self)
         self.params = network.init_params(self.seed)
         self.buffers = network.init_buffers()
@@ -227,6 +237,21 @@ class Trainer:
             placed_slots.append(jax.tree_util.tree_map(place, slot))
         return (jax.device_put(count, replicated(self.mesh)), placed_slots)
 
+    def _step_extras(self) -> Tuple:
+        """Trailing jitted-step inputs beyond ``(params, opt_state,
+        buffers, feed, rng, progress)``: the loss-scale state
+        (``--precision=bf16``) then the health accumulator
+        (``--health_interval``).  THE one definition of the extra-state
+        order — every step variant mirrors it in its trailing outputs,
+        and ``bench._scan_time_ms`` / ``costmodel._step_args`` reuse it
+        instead of re-deriving the tuple."""
+        extras: Tuple = ()
+        if self._ls_state is not None:
+            extras += (self._ls_state,)
+        if self._health is not None:
+            extras += (self._health.ensure_state(),)
+        return extras
+
     @staticmethod
     def _dealias(tree):
         """Copy every leaf so no two donated leaves share a buffer (JAX
@@ -249,7 +274,12 @@ class Trainer:
         sparse_names = {n for n, s in net.param_specs.items()
                         if s.sparse_update}
 
-        def step(params, opt_state, buffers, feed, rng, progress):
+        hs = self._health
+        hs_stats = hs.stats_fn() if hs is not None else None
+        from ..observe import health as _health
+
+        def step(params, opt_state, buffers, feed, rng, progress,
+                 *health_state):
             def loss_fn(p):
                 loss, (values, new_buffers) = net.loss(
                     p, feed, buffers, is_training=True, rng=rng)
@@ -274,10 +304,21 @@ class Trainer:
                 new_params, new_opt = opt.apply(params, grads, opt_state,
                                                 lr, lr_scales,
                                                 sparse_masks=masks)
+            if hs_stats is not None:
+                # the health aux scopes as its own attribution region,
+                # like the optimizer — it must not pollute layer costs
+                with jax.named_scope("health"):
+                    new_health = _health.accumulate(
+                        health_state[0],
+                        hs_stats(grads, params, new_params),
+                        applied=True)
+                return (new_params, new_opt, new_buffers, loss,
+                        new_health)
             return new_params, new_opt, new_buffers, loss
 
         self._raw_step = step   # unjitted; benchmarks scan over it
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        donate = (0, 1, 2, 6) if hs is not None else (0, 1, 2)
+        return jax.jit(step, donate_argnums=donate)
 
     def _build_mixed_train_step(self):
         """The ``--precision=bf16`` train step: fp32 master weights are
@@ -307,8 +348,12 @@ class Trainer:
                 lambda x: x.astype(cd)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
 
+        hs = self._health
+        hs_stats = hs.stats_fn() if hs is not None else None
+        from ..observe import health as _health
+
         def step(params, opt_state, buffers, feed, rng, progress,
-                 ls_state):
+                 ls_state, *health_state):
             with policy_scope(pol):
                 def loss_fn(p):
                     # net.forward updates its ctx.buffers dict IN PLACE
@@ -324,7 +369,15 @@ class Trainer:
                 (_, (loss, new_buffers)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
             grads = ls.unscale(grads, ls_state.scale)
-            finite = ls.all_finite(grads)
+            if hs_stats is not None:
+                # skip-step disambiguation: ONE isfinite sweep yields
+                # both the loss-scale skip decision and the per-layer
+                # non-finite localization counts
+                nf_counts = ls.leaf_nonfinite_counts(grads)
+                finite = ls.all_finite_from_counts(nf_counts)
+            else:
+                nf_counts = None
+                finite = ls.all_finite(grads)
             if self._prune_masks:
                 from ..optimizer.hooks import apply_prune_grads
                 grads = apply_prune_grads(grads, self._prune_masks)
@@ -343,10 +396,22 @@ class Trainer:
                 new_opt = ls.select(finite, new_opt, opt_state)
                 new_buffers = ls.select(finite, new_buffers, buffers)
                 new_ls = ls.update(ls_state, finite, growth_interval)
+            if hs_stats is not None:
+                # post-select new_params: a skipped step reports a zero
+                # update norm (nothing was applied), and its non-finite
+                # counts land in the benign bucket (applied=finite)
+                with jax.named_scope("health"):
+                    new_health = _health.accumulate(
+                        health_state[0],
+                        hs_stats(grads, params, new_params, nf_counts),
+                        applied=finite)
+                return (new_params, new_opt, new_buffers, loss, new_ls,
+                        new_health)
             return new_params, new_opt, new_buffers, loss, new_ls
 
         self._raw_step = step   # unjitted; benchmarks scan over it
-        return jax.jit(step, donate_argnums=(0, 1, 2, 6))
+        donate = (0, 1, 2, 6, 7) if hs is not None else (0, 1, 2, 6)
+        return jax.jit(step, donate_argnums=donate)
 
     def _eval_output_names(self) -> List[str]:
         """Layers whose values evaluators should see: a declared output that
@@ -461,9 +526,22 @@ class Trainer:
             if self._ls_state is not None:
                 self._ls_state = self._replicate(
                     self._dealias(self._ls_state))
-        with trace.span("train_step", samples_seen=self.samples_seen):
+            if self._health is not None:
+                self._health.ensure_state(place=self._replicate)
+        with trace.span("train_step",
+                        samples_seen=self.samples_seen) as sp:
             t0, t_feed, t_done, batch, loss = \
                 self._traced_step_body(feed, placed)
+            if self._health is not None and self._health.step_done():
+                # drain due: the small D2H fetch below is the health
+                # path's only fence, amortized over --health_interval
+                # steps; its summary lands on this step's span
+                report = self._health.drain(loss=float(loss),
+                                            place=self._replicate)
+                if report is not None \
+                        and isinstance(getattr(sp, "attrs", None),
+                                       dict):
+                    sp.attrs.update(self._health.span_summary(report))
         observe.histogram(
             "train_host_feed_seconds",
             "host time sharding/placing the feed per step"
@@ -491,15 +569,18 @@ class Trainer:
         t_feed = time.perf_counter()
         with trace.span("step_dispatch"), global_stat.timer("train_batch"):
             progress = jnp.asarray(self.samples_seen, jnp.float32)
+            # every step variant returns (params, opt, buffers, loss,
+            # *extras) with the extras mirroring the trailing inputs
+            # (_step_extras order), so dispatch/unpack is uniform
+            out = self._train_step(self.params, self.opt_state,
+                                   self.buffers, feed, rng, progress,
+                                   *self._step_extras())
+            self.params, self.opt_state, self.buffers, loss = out[:4]
+            tail = out[4:]
             if self._ls_state is not None:
-                (self.params, self.opt_state, self.buffers, loss,
-                 self._ls_state) = self._train_step(
-                    self.params, self.opt_state, self.buffers, feed,
-                    rng, progress, self._ls_state)
-            else:
-                self.params, self.opt_state, self.buffers, loss = \
-                    self._train_step(self.params, self.opt_state,
-                                     self.buffers, feed, rng, progress)
+                self._ls_state, tail = tail[0], tail[1:]
+            if self._health is not None:
+                self._health.state = tail[0]
         self._count_recompiles()
         t_dispatch = time.perf_counter()
         # fence when anyone is LISTENING: a metrics sink (the
@@ -560,6 +641,10 @@ class Trainer:
         from ..observe import http as ohttp
         from ..observe import memory as omem
 
+        if self._health is not None and self._health.pending():
+            # end-of-pass drain: whatever accumulated since the last
+            # interval boundary is published before the pass closes
+            self._health.drain(place=self._replicate)
         if observe.active() or ohttp.serving():
             omem.sample(self, feed=self._roofline_feed)
         path = FLAGS.roofline_dump
@@ -570,6 +655,22 @@ class Trainer:
             report = costmodel.analyze_trainer_step(
                 self, self._roofline_feed)
             if report is not None:
+                # stamp MFU when a fenced step time exists (a metrics
+                # sink fenced the steps) — makes two dumps diffable on
+                # MFU by --attribution_diff without an extra bench run
+                fenced = observe.histogram(
+                    "train_device_blocked_seconds",
+                    "time blocked on the device per step (fenced; only "
+                    "recorded while a metrics sink or trace is "
+                    "attached)")
+                # reservoir first: exact order statistic, where the
+                # fixed latency buckets only interpolate (a step time
+                # mid-bucket can read up to ~40% off)
+                p50 = fenced.sample_quantile(0.5) or fenced.quantile(0.5)
+                if p50 and report.get("flops_per_step"):
+                    report["mfu_est"] = round(costmodel.mfu(
+                        report["flops_per_step"], p50,
+                        devices=max(self.mesh.devices.size, 1)), 4)
                 costmodel.dump_report(report, path)
                 log.info("roofline/cost attribution written to %s "
                          "(%d regions)", path, len(report["regions"]))

@@ -216,6 +216,39 @@ FLAGS.define("debug_dump_signal", False,
              "post-mortem for wedged runs without a debugger")
 FLAGS.define("debug_dump_dir", "/tmp",
              "output directory for --debug_dump_signal dumps")
+FLAGS.define("health_interval", 0,
+             "training-health telemetry (observe/health.py): every N "
+             "steps drain the on-device per-layer accumulators — "
+             "gradient/parameter norms, update ratios ||dw||/||w||, "
+             "non-finite localization — into observe gauges, /metrics "
+             "and the host-side detectors (loss spike/plateau, "
+             "dead/exploding layers).  The aux path is fused into the "
+             "jitted train step and keyed to the same layer names as "
+             "the roofline attribution; the drain's small D2H fetch is "
+             "the only fence, amortized over N steps.  0 (default) = "
+             "off: the step is built without any aux outputs, "
+             "byte-for-byte the legacy program")
+FLAGS.define("health_window", 32,
+             "rolling window (in drains) for the loss median/MAD "
+             "robust statistics behind the spike/plateau detectors")
+FLAGS.define("health_spike_mad", 8.0,
+             "loss-spike threshold: alert when loss exceeds the "
+             "rolling median by this many robust sigmas (1.4826*MAD)")
+FLAGS.define("health_plateau_rtol", 1e-4,
+             "loss-plateau threshold: alert when the loss window's "
+             "full range stays within this relative tolerance of the "
+             "median for a whole window")
+FLAGS.define("health_dead_ratio", 1e-10,
+             "dead-layer threshold: alert when a layer's update ratio "
+             "||dw||/||w|| stays at or below this for "
+             "--health_patience consecutive drains")
+FLAGS.define("health_explode_ratio", 0.5,
+             "exploding-layer threshold: alert when a layer's update "
+             "ratio exceeds this for --health_patience consecutive "
+             "drains")
+FLAGS.define("health_patience", 2,
+             "consecutive drains a dead/exploding condition must "
+             "persist before its alert fires")
 FLAGS.define("roofline_dump", "",
              "write the attributed per-region roofline/cost report of "
              "the compiled train step (observe/costmodel.py: FLOPs / "

@@ -181,6 +181,13 @@ def _reset_global_state(_io_thread_leak_guard):
     # them must not leak its recorder/server (threads) into the next
     observe.trace.disable()
     observe.http.stop_global()
+    # the training-health observatory keeps a process-wide latest
+    # report for /health — resolved through sys.modules so tests that
+    # never import it pay nothing
+    import sys as _sys
+    hmod = _sys.modules.get("paddle_tpu.observe.health")
+    if hmod is not None:
+        hmod.reset()
 
 
 # Thread-leak guard: every framework-owned service thread is named so

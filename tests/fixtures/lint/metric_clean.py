@@ -35,3 +35,11 @@ class OtherTracer:
 
     def handle(self, request_id):
         return self.trace.span(f"req-{request_id}")
+
+
+def health_drain(layer, kind):
+    """Health-metric registration shape: literal family names, the
+    per-layer/per-kind variability carried entirely in labels."""
+    observe.gauge("health_grad_norm").set(1.0, layer=layer)
+    observe.counter("health_alerts_total").inc(kind=kind, layer=layer)
+    observe.histogram("health_loss").observe(0.5)

@@ -1,0 +1,196 @@
+"""``bench.py --attribution_diff`` + costmodel schema/diff (round 16).
+
+The machine-checked before/after attribution loop: two roofline dumps
+are committed under ``benchmark/rooflines/`` (a real fc-trainer report
+and a derived "after a kernel PR" variant: one region's HBM bytes cut
+40%, one region renamed, one removed, one added) and tier-1 replays
+``bench.py --attribution_diff`` over them, pinning the per-region
+deltas — so the diff contract can never drift from the committed
+artifacts without this file noticing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.observe import costmodel
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OLD = os.path.join(REPO, "benchmark", "rooflines", "fc_sgd_before.json")
+NEW = os.path.join(REPO, "benchmark", "rooflines", "fc_sgd_after.json")
+
+
+# ------------------------------------------------------------- schema
+def test_committed_dumps_are_schema_v2():
+    for path in (OLD, NEW):
+        rep = costmodel.load_report(path)
+        assert rep["schema"] == costmodel.SCHEMA_VERSION == 2
+        assert rep["regions"] and rep["peaks"]["ridge"] > 0
+
+
+def test_load_report_stamps_v1_on_unversioned(tmp_path):
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps({"regions": [], "flops_per_step": 1.0}))
+    assert costmodel.load_report(str(p))["schema"] == 1
+
+
+def test_load_report_rejects_non_reports(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"metric": "lstm"}))
+    with pytest.raises(ValueError):
+        costmodel.load_report(str(p))
+
+
+def test_dump_report_stamps_schema(tmp_path):
+    p = tmp_path / "r.json"
+    costmodel.dump_report({"regions": []}, str(p))
+    assert json.load(open(p))["schema"] == costmodel.SCHEMA_VERSION
+
+
+# ----------------------------------------------------- diff unit pins
+def _diff():
+    return costmodel.attribution_diff(costmodel.load_report(OLD),
+                                      costmodel.load_report(NEW))
+
+
+def test_diff_pins_known_per_region_deltas():
+    d = _diff()
+    rows = {r["region"]: r for r in d["regions"]}
+    # the fusion win: hidden HBM bytes -40%, flops unchanged
+    hid = rows["hidden"]
+    assert hid["status"] == "common"
+    assert hid["bytes_old"] == pytest.approx(16644.0)
+    assert hid["bytes_new"] == pytest.approx(9986.4)
+    assert hid["bytes_delta_frac"] == pytest.approx(-0.4, abs=1e-3)
+    assert hid["flops_delta"] == 0.0
+    assert hid["time_est_s_delta_frac"] == pytest.approx(-0.4,
+                                                         abs=1e-2)
+    # untouched region diffs to zero
+    opt = rows["optimizer"]
+    assert opt["bytes_delta"] == 0.0 and opt["flops_delta"] == 0.0
+    assert not opt["bound_changed"]
+
+
+def test_diff_detects_rename_add_remove():
+    d = _diff()
+    assert d["renamed"] == {"pred_fused": "pred"}
+    assert d["added"] == ["fused_softmax_xent"]
+    assert d["removed"] == ["_unattributed"]
+    rows = {r["region"]: r for r in d["regions"]}
+    ren = rows["pred_fused"]
+    assert ren["status"] == "renamed"
+    assert ren["renamed_from"] == "pred"
+    assert ren["bytes_delta"] == 0.0      # a relabel, not a regression
+    assert rows["fused_softmax_xent"]["status"] == "added"
+    assert rows["_unattributed"]["status"] == "removed"
+
+
+def test_diff_totals_and_verdict():
+    d = _diff()
+    t = d["totals"]
+    assert t["bytes_per_step_old"] == pytest.approx(53120.0)
+    assert t["bytes_per_step_new"] == pytest.approx(46818.4)
+    assert t["bytes_per_step_delta_frac"] == pytest.approx(-0.1186,
+                                                           abs=1e-3)
+    assert t["mfu_est_old"] == pytest.approx(0.0112)
+    assert t["mfu_est_new"] == pytest.approx(0.0134)
+    assert d["ok"] is True and d["regressions"] == []
+    # the fusion win registers as an improvement on hidden bytes
+    assert any(i["region"] == "hidden" and i["field"] == "bytes"
+               for i in d["improvements"])
+
+
+def test_diff_flags_regressions_and_check_gates():
+    old = costmodel.load_report(OLD)
+    worse = costmodel.load_report(OLD)
+    worse["regions"] = json.loads(json.dumps(worse["regions"]))
+    for r in worse["regions"]:
+        if r["region"] == "hidden":
+            r["bytes"] *= 1.5             # +50% HBM traffic
+    worse["bytes_per_step"] *= 1.2
+    d = costmodel.attribution_diff(old, worse, tolerance=0.05)
+    assert d["ok"] is False
+    fields = {(e["region"], e["field"]) for e in d["regressions"]}
+    assert ("hidden", "bytes") in fields
+    assert ("_total", "bytes_per_step") in fields
+    # inside tolerance: no verdict
+    ok = costmodel.attribution_diff(old, old, tolerance=0.05)
+    assert ok["ok"] is True and ok["regressions"] == []
+
+
+def test_rename_matching_refuses_ambiguity():
+    base = {"schema": 2, "regions": [
+        {"region": "a", "flops": 100.0, "bytes": 50.0},
+        {"region": "b", "flops": 100.0, "bytes": 50.0}],
+        "flops_per_step": 200.0, "bytes_per_step": 100.0}
+    new = {"schema": 2, "regions": [
+        {"region": "c", "flops": 100.0, "bytes": 50.0}],
+        "flops_per_step": 100.0, "bytes_per_step": 50.0}
+    d = costmodel.attribution_diff(base, new)
+    # two equal-cost removal candidates: an honest add+remove beats a
+    # guessed rename
+    assert d["renamed"] == {}
+    assert d["added"] == ["c"] and sorted(d["removed"]) == ["a", "b"]
+    # the symmetric case — one removed region, two added regions that
+    # both match it — must refuse just the same (no iteration-order
+    # coin flip deciding which one "renamed")
+    d2 = costmodel.attribution_diff(new, base)
+    assert d2["renamed"] == {}
+    assert sorted(d2["added"]) == ["a", "b"] and d2["removed"] == ["c"]
+
+
+def test_render_diff_table_mentions_every_region():
+    d = _diff()
+    table = costmodel.render_diff_table(d)
+    for r in d["regions"]:
+        assert r["region"] in table
+    assert "pred->pred_fused" in table
+    assert "ok=True" in table
+
+
+# -------------------------------------------------------- bench.py CLI
+def test_bench_attribution_diff_cli_replays_committed_dumps():
+    """The full CLI path over the committed artifacts: JSON on stdout,
+    human table on stderr, exit 0 (and 2 under --check only when a
+    regression exists)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--attribution_diff", OLD, NEW, "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    diff = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert diff["kind"] == "attribution_diff"
+    assert diff["ok"] is True
+    assert diff["renamed"] == {"pred_fused": "pred"}
+    rows = {r["region"]: r for r in diff["regions"]}
+    assert rows["hidden"]["bytes_delta_frac"] == pytest.approx(
+        -0.4, abs=1e-3)
+    assert "hidden" in proc.stderr and "renamed" in proc.stderr
+
+
+def test_bench_attribution_diff_check_exits_2_on_regression(tmp_path):
+    worse = costmodel.load_report(OLD)
+    for r in worse["regions"]:
+        r["bytes"] = r["bytes"] * 2.0     # every region doubled
+    p = tmp_path / "worse.json"
+    costmodel.dump_report(worse, str(p))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--attribution_diff", OLD, str(p), "--check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc.returncode == 2
+    diff = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert diff["ok"] is False and diff["regressions"]
+    # report-only mode still exits 0
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--attribution_diff", OLD, str(p), "--check",
+         "--check_report_only"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert proc2.returncode == 0

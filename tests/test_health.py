@@ -1,0 +1,513 @@
+"""Training-health observatory (round 16, observe/health.py).
+
+Covers the tentpole end to end:
+
+- layer keying: the health layer map uses the SAME names as the
+  roofline attribution regions;
+- trainer fusion: with ``--health_interval N`` per-layer
+  grad/param/update-ratio gauges land in the registry and on
+  ``/metrics``; with the default 0 the trainer carries no health
+  session and the step math is untouched (health on/off trajectories
+  are byte-identical — the aux path observes, never perturbs);
+- skip-step disambiguation: a seeded bf16 overflow increments
+  ``loss_scale_skipped_steps_total`` and the *benign* non-finite
+  counter but fires NO alert; the same overflow under fp32 (no loss
+  scaling to skip the step) localizes the first non-finite layer and
+  alerts;
+- host-side detectors: loss spike / plateau via rolling median-MAD,
+  dead and exploding layers via the update ratio, warn-once alert
+  semantics and the ``health_alerts_total`` counter;
+- the live surfaces: ``/health`` detail, ``/healthz`` degraded-but-
+  alive summary, ``/roofline``, and the ``train_step`` span attrs.
+"""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.config.model_config import OptimizationConfig
+from paddle_tpu.data.feeder import dense_vector, integer_value
+from paddle_tpu.layers import NeuralNetwork
+from paddle_tpu.observe import health, trace
+from paddle_tpu.observe.http import ObservabilityServer
+from paddle_tpu.trainer.trainer import Trainer
+from paddle_tpu.utils import FLAGS
+
+HEALTH_FLAGS = ("health_interval", "health_window", "health_spike_mad",
+                "health_plateau_rtol", "health_dead_ratio",
+                "health_explode_ratio", "health_patience",
+                "precision", "loss_scale_init", "prefetch_depth",
+                "roofline_dump")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    saved = {k: FLAGS.get(k) for k in HEALTH_FLAGS}
+    yield
+    for k, v in saved.items():
+        FLAGS.set(k, v)
+    health.reset()
+
+
+def _fc_trainer(precision="", seed=0, lr=1e-2):
+    with config_scope():
+        img = dsl.data_layer("x", dense_vector(16))
+        lbl = dsl.data_layer("label", integer_value(4))
+        h = dsl.fc_layer(img, size=32, act=dsl.ReluActivation(),
+                         name="hidden")
+        pred = dsl.fc_layer(h, size=4, act=dsl.SoftmaxActivation(),
+                            name="pred")
+        cfg = dsl.topology(dsl.classification_cost(pred, lbl))
+    net = NeuralNetwork(cfg)
+    oc = OptimizationConfig(learning_method="adam", learning_rate=lr,
+                            precision=precision)
+    return Trainer(net, opt_config=oc, seed=seed)
+
+
+def _feed(rng, b=8):
+    return {"x": jnp.asarray(rng.randn(b, 16).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 4, (b,))
+                                 .astype(np.int32))}
+
+
+def _inf_feed(label):
+    return {"x": jnp.full((8, 16), np.inf, jnp.float32),
+            "label": label}
+
+
+def _bytes(tree):
+    return {k: np.asarray(v).tobytes()
+            for k, v in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+# ------------------------------------------------------------ layer map
+def test_layer_param_map_matches_roofline_region_names():
+    from paddle_tpu.observe import costmodel
+
+    t = _fc_trainer()
+    pairs = health.layer_param_map(t.network)
+    names = [k for k, _ in pairs]
+    assert names == ["hidden", "pred"]
+    known = costmodel._known_regions(t.network)
+    assert set(names) <= known
+    # every trainable parameter is claimed by exactly one layer
+    claimed = [p for _, ps in pairs for p in ps]
+    assert sorted(claimed) == sorted(t.network.param_specs)
+    assert len(claimed) == len(set(claimed))
+
+
+def test_layer_param_map_unclaimed_params_fall_back():
+    t = _fc_trainer()
+
+    class NoParams:
+        def param_specs(self):
+            return []
+
+    # simulate a network whose layers claim nothing: everything must
+    # land in the _unattributed bucket, not vanish
+    class FakeNet:
+        layers = {"l1": NoParams()}
+        groups = {}
+        param_specs = t.network.param_specs
+
+    pairs = health.layer_param_map(FakeNet())
+    assert pairs == [(health.UNATTRIBUTED,
+                      sorted(t.network.param_specs))]
+
+
+# ------------------------------------------------- trainer wiring (fp32)
+def test_health_off_by_default_no_session_no_extra_outputs():
+    t = _fc_trainer()
+    assert t._health is None
+    rng = np.random.RandomState(0)
+    t.train_one_batch(_feed(rng))
+    # legacy arity: the jitted step returned exactly 4 outputs (no aux)
+    out = t._raw_step(t.params, t.opt_state, t.buffers, _feed(rng),
+                      jax.random.PRNGKey(0),
+                      jnp.zeros((), jnp.float32))
+    assert len(out) == 4
+
+
+def test_health_aux_does_not_perturb_training():
+    rng = np.random.RandomState(1)
+    feeds = [_feed(rng) for _ in range(4)]
+    t_off = _fc_trainer()
+    FLAGS.set("health_interval", 2)
+    t_on = _fc_trainer()
+    assert t_on._health is not None
+    for f in feeds:
+        t_off.train_one_batch(dict(f))
+        t_on.train_one_batch(dict(f))
+    assert _bytes(t_off.params) == _bytes(t_on.params)
+    assert _bytes(t_off.opt_state) == _bytes(t_on.opt_state)
+
+
+def test_health_gauges_keyed_to_layer_names():
+    FLAGS.set("health_interval", 2)
+    t = _fc_trainer()
+    rng = np.random.RandomState(2)
+    for _ in range(4):
+        t.train_one_batch(_feed(rng))
+    for layer in ("hidden", "pred"):
+        g = observe.gauge("health_grad_norm").value(layer=layer)
+        p = observe.gauge("health_param_norm").value(layer=layer)
+        r = observe.gauge("health_update_ratio").value(layer=layer)
+        assert g > 0 and p > 0 and 0 < r < 1
+    assert observe.counter("health_drains_total").value() == 2.0
+    report = health.latest_report()
+    assert report is not None
+    assert sorted(report["layers"]) == ["hidden", "pred"]
+    assert report["steps"] == 2
+    # the update ratio is ||dw||/||w|| of the drained step
+    row = report["layers"]["hidden"]
+    assert row["update_ratio"] == pytest.approx(
+        row["update_norm"] / row["param_norm"], rel=1e-6)
+
+
+def test_health_interval_drain_cadence_and_pass_boundary():
+    FLAGS.set("health_interval", 3)
+    FLAGS.set("prefetch_depth", 0)
+    t = _fc_trainer()
+    rng = np.random.RandomState(3)
+    batches = [_feed(rng) for _ in range(4)]
+
+    def reader():
+        return iter([{k: np.asarray(v) for k, v in b.items()}
+                     for b in batches])
+
+    t.train(reader, num_passes=1)
+    # 4 steps at interval 3 = one interval drain + one boundary drain
+    assert observe.counter("health_drains_total").value() == 2.0
+    assert t._health.pending() is False
+    report = health.latest_report()
+    assert report["base_step"] == 3 and report["steps"] == 1
+
+
+def test_health_metrics_on_prometheus_endpoint():
+    FLAGS.set("health_interval", 1)
+    t = _fc_trainer()
+    rng = np.random.RandomState(4)
+    t.train_one_batch(_feed(rng))
+    with ObservabilityServer(port=0) as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            text = resp.read().decode()
+    assert 'health_grad_norm{layer="hidden"}' in text
+    assert 'health_grad_norm{layer="pred"}' in text
+    assert 'health_update_ratio{layer="pred"}' in text
+
+
+# --------------------------------------- non-finite: benign vs pathological
+def test_bf16_overflow_skip_is_benign_no_alert():
+    """The satellite regression: a seeded-overflow loss-scale skip must
+    increment ``loss_scale_skipped_steps_total`` but must NOT fire
+    non-finite or loss-spike alerts."""
+    FLAGS.set("health_interval", 4)
+    FLAGS.set("loss_scale_init", 1024.0)
+    rng = np.random.RandomState(5)
+    t = _fc_trainer(precision="bf16")
+    good = _feed(rng)
+    t.train_one_batch(dict(good))
+    p0 = _bytes(t.params)
+    t.train_one_batch(_inf_feed(good["label"]))   # seeded overflow
+    assert _bytes(t.params) == p0                 # skipped, bit-identical
+    t.train_one_batch(dict(good))
+    t.train_one_batch(dict(good))                 # 4th step: drain
+    t._sync_precision_metrics()
+    assert observe.counter(
+        "loss_scale_skipped_steps_total").value() == 1.0
+    nf = observe.counter("health_nonfinite_steps_total")
+    assert nf.value(layer="hidden", benign="true") >= 1.0
+    assert nf.value(layer="hidden", benign="false") == 0.0
+    assert nf.value(layer="pred", benign="false") == 0.0
+    # no alert of ANY kind fired — the skip is business as usual
+    assert observe.counter("health_alerts_total").total() == 0.0
+    assert health.status_summary()["status"] == "ok"
+
+
+def test_fp32_nonfinite_localizes_and_alerts():
+    FLAGS.set("health_interval", 2)
+    rng = np.random.RandomState(6)
+    t = _fc_trainer()
+    good = _feed(rng)
+    t.train_one_batch(dict(good))
+    t.train_one_batch(_inf_feed(good["label"]))   # applied: pathological
+    alerts = observe.counter("health_alerts_total")
+    assert alerts.value(kind="nonfinite", layer="hidden") == 1.0
+    report = health.latest_report()
+    assert report["layers"]["hidden"]["nonfinite_steps"] == 1
+    assert report["layers"]["hidden"]["first_nonfinite"] == 1
+    assert report["alerts"] and \
+        report["alerts"][0]["kind"] == "nonfinite"
+    assert health.status_summary()["status"] == "degraded"
+
+
+def test_first_nonfinite_step_index_survives_accumulation():
+    FLAGS.set("health_interval", 3)
+    rng = np.random.RandomState(7)
+    t = _fc_trainer()
+    good = _feed(rng)
+    t.train_one_batch(dict(good))                 # step 0: clean
+    t.train_one_batch(_inf_feed(good["label"]))   # step 1: inf
+    t.train_one_batch(_inf_feed(good["label"]))   # step 2: still inf
+    report = health.latest_report()
+    assert report["layers"]["hidden"]["first_nonfinite"] == 1
+    assert report["layers"]["hidden"]["nonfinite_steps"] == 2
+
+
+# ------------------------------------------------------- detector units
+def _report(layers, base=0, steps=1):
+    return {"ts": 0.0, "steps": steps, "base_step": base,
+            "interval": 1, "loss": None, "layers": layers}
+
+
+def _row(grad=1.0, param=10.0, update=0.01, nf=0, benign=0, first=-1):
+    return {"grad_norm": grad, "param_norm": param,
+            "update_norm": update,
+            "update_ratio": update / param if param else None,
+            "nonfinite_steps": nf, "benign_nonfinite_steps": benign,
+            "first_nonfinite": first}
+
+
+def test_monitor_loss_spike_fires_once():
+    m = health.HealthMonitor(["l"], window=8, spike_mad=6.0)
+    for i in range(8):
+        m.observe(_report({"l": _row()}), 1.0 + 0.01 * (i % 3))
+    fired = m.observe(_report({"l": _row()}), 50.0)
+    assert [a["kind"] for a in fired] == ["loss_spike"]
+    # warn-once: a second spike does not re-emit the structured alert
+    assert m.observe(_report({"l": _row()}), 60.0) == []
+    assert observe.counter("health_alerts_total").value(
+        kind="loss_spike", layer="_model") == 2.0
+
+
+def test_monitor_loss_plateau_detection():
+    m = health.HealthMonitor(["l"], window=6, plateau_rtol=1e-3)
+    fired = []
+    for _ in range(8):
+        fired += m.observe(_report({"l": _row()}), 2.0)
+    assert [a["kind"] for a in fired] == ["loss_plateau"]
+
+
+def test_monitor_dead_layer_needs_patience():
+    m = health.HealthMonitor(["l"], patience=2, dead_ratio=1e-10)
+    dead = {"l": _row(grad=0.0, update=0.0)}
+    assert m.observe(_report(dead), 1.0) == []          # streak 1
+    fired = m.observe(_report(dead), 1.0)               # streak 2
+    assert [a["kind"] for a in fired] == ["dead_layer"]
+    # recovery resets the streak
+    m2 = health.HealthMonitor(["l"], patience=2, dead_ratio=1e-10)
+    m2.observe(_report(dead), 1.0)
+    m2.observe(_report({"l": _row()}), 1.0)             # healthy
+    assert m2.observe(_report(dead), 1.0) == []         # streak back to 1
+
+
+def test_monitor_exploding_layer():
+    m = health.HealthMonitor(["l"], patience=2, explode_ratio=0.5)
+    hot = {"l": _row(update=9.0, param=10.0)}           # ratio 0.9
+    m.observe(_report(hot), 1.0)
+    fired = m.observe(_report(hot), 1.0)
+    assert [a["kind"] for a in fired] == ["exploding_layer"]
+
+
+def test_monitor_streaks_reset_on_unreadable_drain():
+    """'N consecutive drains' means CONSECUTIVE: a drain whose norms
+    were non-finite (row reads None) breaks a dead/exploding streak
+    instead of letting it straddle the gap."""
+    m = health.HealthMonitor(["l"], patience=2, dead_ratio=1e-10)
+    dead = {"l": _row(grad=0.0, update=0.0)}
+    unreadable = {"l": dict(_row(), grad_norm=None, update_ratio=None)}
+    assert m.observe(_report(dead), 1.0) == []          # streak 1
+    assert m.observe(_report(unreadable), 1.0) == []    # streak broken
+    assert m.observe(_report(dead), 1.0) == []          # streak 1 again
+    fired = m.observe(_report(dead), 1.0)               # streak 2
+    assert [a["kind"] for a in fired] == ["dead_layer"]
+
+
+def test_status_recovers_after_transient_condition():
+    """/healthz 'degraded' means STANDING conditions: a transient
+    incident degrades the drain it happened on, and a clean next drain
+    flips the digest back to ok — while the historical alert stays
+    visible in last_alerts for forensics."""
+    m = health.HealthMonitor(["l"], patience=1, explode_ratio=0.5)
+    hot = {"l": _row(update=9.0, param=10.0)}
+    m.observe(_report(hot), 1.0)
+    health.publish_report(_report(hot), m)
+    assert health.status_summary()["status"] == "degraded"
+    assert m.active_conditions() == [("exploding_layer", "l")]
+    m.observe(_report({"l": _row()}), 1.0)      # recovered
+    assert m.active_conditions() == []
+    s = health.status_summary()
+    assert s["status"] == "ok"
+    assert s["alerts_total"] == 1               # the incident is kept
+
+
+def test_monitor_benign_nonfinite_never_alerts():
+    m = health.HealthMonitor(["l"])
+    benign = {"l": _row(grad=None, update=0.0, nf=0, benign=3,
+                        first=0)}
+    benign["l"]["grad_norm"] = None
+    assert m.observe(_report(benign), 1.0) == []
+    assert observe.counter("health_alerts_total").total() == 0.0
+
+
+# ------------------------------------------------------- live endpoints
+def test_health_endpoint_serves_latest_report():
+    FLAGS.set("health_interval", 1)
+    t = _fc_trainer()
+    rng = np.random.RandomState(8)
+    t.train_one_batch(_feed(rng))
+    with ObservabilityServer(port=0) as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health") as resp:
+            body = json.loads(resp.read().decode())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz") as resp:
+            hz = json.loads(resp.read().decode())
+    assert sorted(body["layers"]) == ["hidden", "pred"]
+    assert body["layers"]["hidden"]["grad_norm"] > 0
+    assert hz["status"] == "ok" and hz["health"]["alerts_total"] == 0
+
+
+def test_health_endpoint_404_before_first_drain():
+    health.reset()
+    with ObservabilityServer(port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/health")
+        assert ei.value.code == 404
+
+
+def test_healthz_degrades_on_alert_but_stays_200():
+    FLAGS.set("health_interval", 2)
+    rng = np.random.RandomState(9)
+    t = _fc_trainer()
+    good = _feed(rng)
+    t.train_one_batch(dict(good))
+    t.train_one_batch(_inf_feed(good["label"]))
+    with ObservabilityServer(port=0) as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz") as resp:
+            assert resp.status == 200          # degraded-but-ALIVE
+            hz = json.loads(resp.read().decode())
+    assert hz["status"] == "degraded"
+    assert hz["health"]["alerts_total"] >= 1
+    assert hz["health"]["last_alerts"][0]["kind"] == "nonfinite"
+
+
+def test_roofline_endpoint_serves_latest_analysis():
+    from paddle_tpu.observe import costmodel
+
+    t = _fc_trainer()
+    rng = np.random.RandomState(10)
+    feed = _feed(rng)
+    t.train_one_batch(dict(feed))
+    costmodel.analyze_trainer_step(t, feed)
+    with ObservabilityServer(port=0) as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/roofline") as resp:
+            body = json.loads(resp.read().decode())
+    assert body["schema"] == costmodel.SCHEMA_VERSION
+    regions = [r["region"] for r in body["regions"]]
+    assert "hidden" in regions and "optimizer" in regions
+
+
+def test_train_step_span_carries_drain_summary():
+    FLAGS.set("health_interval", 1)
+    trace.ensure_ring(ring_size=64)
+    try:
+        t = _fc_trainer()
+        rng = np.random.RandomState(11)
+        t.train_one_batch(_feed(rng))
+        steps = [e for e in trace.events()
+                 if e["name"] == "train_step"]
+        assert steps
+        args = steps[-1]["args"]
+        assert args["health_drained_steps"] == 1
+        assert args["health_grad_norm_max_layer"] in ("hidden", "pred")
+    finally:
+        trace.disable()
+
+
+# -------------------------------------------------- bf16 + roofline key
+def test_bf16_health_aux_rides_the_mixed_step():
+    FLAGS.set("health_interval", 1)
+    rng = np.random.RandomState(12)
+    t = _fc_trainer(precision="bf16")
+    for _ in range(2):
+        t.train_one_batch(_feed(rng))
+    assert observe.gauge("health_grad_norm").value(layer="pred") > 0
+    # master weights stayed fp32 with the aux fused in
+    for leaf in jax.tree_util.tree_leaves(t.params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_roofline_analysis_works_with_health_enabled():
+    """--roofline_dump and --health_interval compose: the analyzer
+    lowers the step WITH the health accumulator argument and the aux
+    cost lands in its own 'health' region (or is fused beyond
+    attribution), never crashing the report."""
+    from paddle_tpu.observe import costmodel
+
+    FLAGS.set("health_interval", 1)
+    t = _fc_trainer()
+    rng = np.random.RandomState(13)
+    feed = _feed(rng)
+    t.train_one_batch(dict(feed))
+    report = costmodel.analyze_trainer_step(t, feed)
+    assert report is not None
+    regions = [r["region"] for r in report["regions"]]
+    assert "hidden" in regions and "pred" in regions
+
+
+def test_drain_handles_nonfinite_norm_values():
+    """A layer whose gradient went inf must not poison the gauges/JSON:
+    non-finite norms publish as None in the report, the norm gauge
+    keeps its last finite reading, and the 0/1 divergence flag says so
+    on /metrics."""
+    FLAGS.set("health_interval", 1)
+    rng = np.random.RandomState(14)
+    t = _fc_trainer()
+    good = _feed(rng)
+    t.train_one_batch(_inf_feed(good["label"]))
+    report = health.latest_report()
+    row = report["layers"]["hidden"]
+    assert row["grad_norm"] is None     # inf sanitized for JSON
+    assert json.dumps(report)           # the /health body serializes
+    for v in (row["param_norm"],):
+        assert v is None or math.isfinite(v)
+    # the live divergence flag marks the layer the stale norm gauge
+    # cannot (review finding: a dashboard must not read 'healthy' off
+    # a last-finite reading at the moment of divergence)
+    assert observe.gauge("health_layer_nonfinite").value(
+        layer="hidden") == 1.0
+    t.train_one_batch(dict(good))       # recovers on a finite step
+    assert observe.gauge("health_layer_nonfinite").value(
+        layer="hidden") == 0.0
+
+
+def test_health_report_shows_ongoing_incident_beyond_first_drain():
+    """/health must show a STANDING incident even after the warn-once
+    newly-fired list goes empty: the report carries active conditions
+    and the recent alert log alongside."""
+    FLAGS.set("health_interval", 1)
+    FLAGS.set("health_patience", 1)
+    FLAGS.set("health_explode_ratio", 1e-9)   # every step "explodes"
+    rng = np.random.RandomState(15)
+    t = _fc_trainer()
+    t.train_one_batch(_feed(rng))
+    t.train_one_batch(_feed(rng))             # second drain: warn-once
+    report = health.latest_report()           # -> newly-fired is empty
+    assert report["alerts"] == []
+    kinds = {a["kind"] for a in report["active"]}
+    assert "exploding_layer" in kinds
+    assert report["recent_alerts"]

@@ -427,3 +427,58 @@ def test_network_fused_pair_census_resnet():
     assert len(net._bn_conv_fuse) == 32
     assert g.value(direction="bwd", kernel="3x3") \
         == len(net._conv_bn_fuse) == 0
+
+
+# --------------------------------------------- bounded sample reservoir
+def test_histogram_reservoir_bounded_over_a_million_observations():
+    """Retention is CAPPED: a 10^6-observation series keeps at most
+    sample_cap raw samples, and the reservoir quantiles still land
+    within tolerance of the true distribution — the long-training-run
+    memory contract."""
+    h = Histogram("step_seconds", buckets=(0.5, 1.0), sample_cap=1024)
+    rng = np.random.RandomState(7)
+    # uniform [0, 100): true p50 = 50, p99 = 99 — far past the last
+    # finite bucket bound, where bucket interpolation clamps to 1.0
+    for v in rng.uniform(0.0, 100.0, size=1_000_000):
+        h.observe(float(v))
+    assert h.count() == 1_000_000
+    assert h.retained_samples() <= 1024
+    assert h.sample_quantile(0.5) == pytest.approx(50.0, rel=0.08)
+    assert h.sample_quantile(0.99) == pytest.approx(99.0, rel=0.08)
+    # the bucket path still clamps (unchanged legacy semantics)
+    assert h.quantile(0.99) == 1.0
+
+
+def test_histogram_reservoir_exact_under_cap():
+    h = Histogram("lat", sample_cap=64)
+    for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        h.observe(v)
+    assert h.retained_samples() == 5
+    assert h.sample_quantile(0.0) == 1.0
+    assert h.sample_quantile(0.5) == 3.0
+    assert h.sample_quantile(1.0) == 5.0
+    assert h.sample_quantile(0.25) == 2.0       # exact order stats
+
+
+def test_histogram_reservoir_per_label_series_and_disable():
+    h = Histogram("lat", sample_cap=8)
+    h.observe(1.0, op="read")
+    h.observe(9.0, op="write")
+    assert h.sample_quantile(0.5, op="read") == 1.0
+    assert h.sample_quantile(0.5, op="write") == 9.0
+    assert h.sample_quantile(0.5) is None       # unlabeled untouched
+    off = Histogram("lat_off", sample_cap=0)
+    for v in range(100):
+        off.observe(float(v))
+    assert off.retained_samples() == 0
+    assert off.sample_quantile(0.5) is None     # caller falls back
+    assert off.quantile(0.5) is not None        # ...to the bucket path
+
+
+def test_registry_histogram_passes_sample_cap():
+    reg = MetricsRegistry()
+    h = reg.histogram("x_seconds", sample_cap=16)
+    for v in range(64):
+        h.observe(float(v))
+    assert h.retained_samples() == 16
+    assert reg.histogram("x_seconds") is h      # get-or-create intact

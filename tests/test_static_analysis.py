@@ -152,14 +152,16 @@ def test_metric_fixture_catches_every_dynamic_name_class():
     assert all(f.rule == "PT-METRIC" for f in res.findings)
     # f-string counter, concatenated histogram, variable through the
     # imported shim, %-format on REGISTRY, f-string span, call-result
-    # record_span — one per line-pinned site
-    assert _lines(res, "PT-METRIC") == [9, 13, 17, 21, 25, 30]
+    # record_span, concatenated health-alert family — one per
+    # line-pinned site
+    assert _lines(res, "PT-METRIC") == [9, 13, 17, 21, 25, 30, 34]
     by_line = {f.line: f.message for f in res.findings}
     assert "an f-string" in by_line[9]
     assert "concatenation" in by_line[13]
     assert "the variable 'name'" in by_line[17]
     assert by_line[25].startswith("span name")
     assert "a call result" in by_line[30]
+    assert "concatenation" in by_line[34]
     assert "labels" in by_line[9] and "span attrs" in by_line[25]
 
 
