@@ -1053,7 +1053,17 @@ def bench_observe():
     span machinery directly (span count of one hot-path step × the
     measured per-call cost) — the <50 µs/step acceptance bound of the
     disabled-mode contract.  The traced run's file is parsed back
-    (`json.load`) to certify the Chrome trace-event stream."""
+    (`json.load`) to certify the Chrome trace-event stream.
+
+    Round 17 adds the fleet A/B on the SAME row: the identical LSTM
+    lane steps with a live fleet push client (reporter thread POSTing
+    one frame per interval to an in-process aggregator) vs without —
+    `fleet_overhead_us_per_step` is the wall-clock tax the push plane
+    steals from the step loop (the client itself runs off-thread; the
+    bound is GIL/scheduler steal), with the work-based upper bound
+    `fleet_push_cpu_us_per_step` (measured push duration × pushes /
+    steps) stamped alongside.  Both the disabled-trace and the
+    enabled-fleet taxes gate `passed` at 50 µs/step."""
     import json as _json
     import os as _os
     import tempfile
@@ -1118,6 +1128,52 @@ def bench_observe():
     disabled_us = (time.perf_counter() - t0) / n_calls * 1e6 \
         * spans_per_step
 
+    # ---- fleet push A/B (round 17): same lane, push client on vs off.
+    # The client runs on the reporter thread, so the per-step tax is
+    # scheduler/GIL steal, not step-path work; interleaved like the
+    # trace A/B so drift hits both modes equally.  The bench CRANKS
+    # the push interval (0.1 s vs the 10 s production default) so the
+    # tax is resolvable at all — overhead scales linearly with push
+    # frequency (cost-per-push × step-time ÷ interval), so the
+    # headline `fleet_overhead_us_per_step` is the raw A/B scaled back
+    # to the default interval; the raw cranked-interval number and the
+    # work-based bound (all push wall time ÷ steps) ride along.
+    from paddle_tpu.observe.fleet import FleetAggregator
+
+    FLEET_BENCH_INTERVAL_S = 0.1
+    default_interval_s = 10.0    # utils/flags.py metrics_interval_s
+    agg = FleetAggregator(0).start()
+    fleet_off_ms, fleet_on_ms = [], []
+    push_hist = observe.REGISTRY.histogram("fleet_push_seconds")
+    try:
+        for _ in range(5):
+            # BOTH modes run with a live reporter sink (devnull JSONL)
+            # so observe.active() — and with it the trainer's
+            # metrics-sink step fence — is symmetric; the delta is
+            # push-client cost alone, the same discipline as the
+            # traced-vs-untraced A/B above
+            for on, acc in ((False, fleet_off_ms),
+                            (True, fleet_on_ms)):
+                rep = observe.MetricsReporter(
+                    path=_os.devnull,
+                    interval_s=FLEET_BENCH_INTERVAL_S,
+                    fleet_addr=agg.addr if on else None)
+                rep.start()
+                acc.append(measure_ms())
+                rep.stop()
+        topo = agg.state.topology()
+        fleet_frames = sum(p["frames"] for p in topo["procs"].values())
+        fleet_rollup = agg.state.rollup()["status"]
+    finally:
+        agg.stop()
+    fleet_ab_us = (float(np.median(fleet_on_ms))
+                   - float(np.median(fleet_off_ms))) * 1e3
+    fleet_overhead_us = fleet_ab_us \
+        * (FLEET_BENCH_INTERVAL_S / default_interval_s)
+    # work-based upper bound: ALL push wall time (build + POST, off-
+    # thread) charged to the enabled windows' steps (60 × 5 attempts)
+    fleet_push_cpu_us = push_hist.sum() / (60 * 5) * 1e6
+
     return _finish(_with_band({
         "metric": "observe_trace_overhead_us_per_step",
         "value": round(overhead_us, 1),
@@ -1126,9 +1182,20 @@ def bench_observe():
         "trace_overhead_us_per_step": round(overhead_us, 1),
         "trace_disabled_us_per_step": round(disabled_us, 2),
         "disabled_target_us": 50.0,
-        "passed": disabled_us < 50.0,
+        "fleet_overhead_us_per_step": round(fleet_overhead_us, 2),
+        "fleet_ab_us_per_step_cranked": round(fleet_ab_us, 1),
+        "fleet_push_interval_s": FLEET_BENCH_INTERVAL_S,
+        "fleet_default_interval_s": default_interval_s,
+        "fleet_push_cpu_us_per_step": round(fleet_push_cpu_us, 2),
+        "fleet_target_us": 50.0,
+        "fleet_frames": fleet_frames,
+        "fleet_rollup": fleet_rollup,
+        "passed": disabled_us < 50.0
+        and abs(fleet_overhead_us) < 50.0,
         "ms_untraced": [round(v, 3) for v in off_ms],
         "ms_traced": [round(v, 3) for v in on_ms],
+        "ms_fleet_off": [round(v, 3) for v in fleet_off_ms],
+        "ms_fleet_on": [round(v, 3) for v in fleet_on_ms],
         "trace_events": len(events),
         "trace_file_valid": all(
             k in e for e in events
@@ -1279,6 +1346,9 @@ def main(argv=None):
     if FLAGS.get("log_level"):
         from paddle_tpu.utils import set_log_level
         set_log_level(FLAGS.get("log_level"))
+    # a bench run pushing to a fleet aggregator registers as its own
+    # role — a bench box must never impersonate a trainer in the rollup
+    observe.fleet.set_identity(role="bench")
     observe.start_from_flags()
     if args.profile:
         global PROFILE_DIR

@@ -249,6 +249,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     tp.add_argument("--metrics_port", type=int, default=None,
                     help="serve /metrics + /healthz + /trace on this "
                          "loopback port during the run (0 = off)")
+    tp.add_argument("--metrics_bind", default=None,
+                    help="bind address for --metrics_port (default "
+                         "loopback; non-loopback is an explicit, "
+                         "loudly-warned opt-in — the endpoint is "
+                         "diagnostics, not an external API)")
+    tp.add_argument("--fleet_addr", default=None,
+                    help="push one telemetry frame (metrics + recent "
+                         "spans + health digest) per interval to the "
+                         "fleet aggregator at host:port "
+                         "(observe/fleet.py); a dead aggregator "
+                         "degrades the push sink, never the run")
+    tp.add_argument("--fleet_port", type=int, default=None,
+                    help="host the fleet aggregator in this process: "
+                         "/fleet/metrics /fleet/healthz /fleet/trace "
+                         "/fleet/topology + POST /fleet/push "
+                         "(0 = off)")
+    tp.add_argument("--fleet_id", default=None,
+                    help="logical fleet identity (e.g. trainer-0): "
+                         "stable across restarts so the cluster "
+                         "rollup recovers when this process comes "
+                         "back")
     tp.add_argument("--debug_dump_signal", action="store_true",
                     help="SIGUSR2 dumps metrics + flight-recorder "
                          "trace of the live run to --debug_dump_dir")
@@ -305,6 +326,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--snapshot_period", type=float, default=30.0)
     sp.add_argument("--local_only", action="store_true",
                     help="bind loopback instead of all interfaces")
+    sp.add_argument("--fleet_port", type=int, default=None,
+                    help="also host the fleet telemetry aggregator on "
+                         "this port (observe/fleet.py) — the natural "
+                         "home: trainers already know the master's "
+                         "address (0 = off)")
+    sp.add_argument("--fleet_bind", default=None,
+                    help="aggregator bind address (default loopback; "
+                         "non-loopback warns — not an external API)")
     sp.set_defaults(fn=cmd_master)
 
     vp = sub.add_parser("version", help="print build info")
@@ -332,6 +361,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         FLAGS.set("trace_jsonl", args.trace_jsonl)
     if getattr(args, "metrics_port", None) is not None:
         FLAGS.set("metrics_port", args.metrics_port)
+    if getattr(args, "metrics_bind", None) is not None:
+        FLAGS.set("metrics_bind", args.metrics_bind)
+    if getattr(args, "fleet_addr", None) is not None:
+        FLAGS.set("fleet_addr", args.fleet_addr)
+    if getattr(args, "fleet_port", None) is not None:
+        FLAGS.set("fleet_port", args.fleet_port)
+    if getattr(args, "fleet_id", None) is not None:
+        FLAGS.set("fleet_id", args.fleet_id)
+    if getattr(args, "fleet_bind", None) is not None:
+        FLAGS.set("fleet_bind", args.fleet_bind)
     if getattr(args, "debug_dump_signal", False):
         FLAGS.set("debug_dump_signal", True)
     if getattr(args, "health_interval", None) is not None:

@@ -15,7 +15,7 @@ import os
 import time
 from typing import Callable, List, Optional
 
-from ..observe import counter
+from ..observe import counter, fleet
 from ..trainer.trainer import Trainer
 from ..utils import get_logger
 
@@ -39,6 +39,11 @@ class ElasticTrainer:
         self.ckpt_fail_max = ckpt_fail_max
         self._last_ckpt = 0.0
         self._ckpt_failures = 0  # consecutive save failures
+        # fleet identity: the trainer_id IS the stable logical id —
+        # a SIGKILLed-and-restarted trainer re-registers under the
+        # same key, so the /fleet/healthz rollup flips its 'missing'
+        # entry back to ok instead of mourning a ghost pid forever
+        fleet.set_identity(role="trainer", name=trainer_id)
 
     def resume(self) -> bool:
         """Load the newest *valid* checkpoint if one exists (corrupt

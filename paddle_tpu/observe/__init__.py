@@ -24,7 +24,14 @@ Instrumented surfaces (all against :data:`REGISTRY`):
 - training health: per-layer grad/param norms, update ratios,
   non-finite localization and detector alerts, drained from the
   on-device accumulators every ``--health_interval`` steps
-  (``observe/health.py``, ``trainer/trainer.py``).
+  (``observe/health.py``, ``trainer/trainer.py``);
+- the fleet plane: cross-process push aggregation — every process
+  with ``--fleet_addr`` ships its snapshot + recent spans + health
+  digest to an aggregator any process hosts with ``--fleet_port``
+  (cluster health rollup, merged Prometheus, ONE merged Perfetto
+  timeline; ``observe/fleet.py``), with a chaining SIGTERM hook so
+  the final interval survives an orchestrator kill
+  (``observe/shutdown.py``).
 
 Overhead contract: with no sink attached every instrument is a dict
 lookup + lock + add; anything more expensive (step fencing) is gated on
@@ -51,7 +58,7 @@ from .report import (  # noqa: F401
 )
 from .report import start_from_flags as _start_reporter_from_flags
 from .report import stop_global as _stop_reporter_global
-from . import benchgate, dump, http, memory, trace  # noqa: F401
+from . import benchgate, dump, fleet, http, memory, shutdown, trace  # noqa: F401
 # costmodel and health are NOT imported eagerly: their entry points
 # touch jax (lazily), and keeping them explicit `from
 # paddle_tpu.observe import costmodel` / `... import health` imports
@@ -63,24 +70,33 @@ from . import benchgate, dump, http, memory, trace  # noqa: F401
 def start_from_flags():
     """One call a long-running entry point makes (``Trainer.train``,
     ``bench.main``, the CLI): start every flag-configured observability
-    surface — the ``--metrics_jsonl`` reporter, ``--trace_jsonl`` span
-    sink, the ``--metrics_port`` HTTP endpoint, and the
-    ``--debug_dump_signal`` SIGUSR2 handler.  Each piece is individually
-    idempotent and a no-op when its flag is unset, so with nothing
-    configured this is a few dict lookups and no thread starts."""
+    surface — the ``--metrics_jsonl`` reporter (with the
+    ``--fleet_addr`` push client folded in), ``--trace_jsonl`` span
+    sink, the ``--metrics_port`` HTTP endpoint, the ``--fleet_port``
+    aggregator, the ``--debug_dump_signal`` SIGUSR2 handler, and the
+    graceful-shutdown SIGTERM flush hook (installed only once some
+    surface above actually got configured).  Each piece is
+    individually idempotent and a no-op when its flag is unset, so
+    with nothing configured this is a few dict lookups and no thread
+    starts."""
     reporter = _start_reporter_from_flags()
     trace.start_from_flags()
     http.start_from_flags()
+    fleet.start_from_flags()
     dump.install_from_flags()
+    shutdown.install_from_flags()
     return reporter
 
 
 def stop_global():
     """Stop every process-wide observability surface (reporter + HTTP
-    endpoint + trace sink) — the mirror of :func:`start_from_flags`."""
+    endpoint + fleet aggregator + trace sink + SIGTERM hook) — the
+    mirror of :func:`start_from_flags`."""
     _stop_reporter_global()
     http.stop_global()
+    fleet.stop_global()
     trace.disable()
+    shutdown.uninstall()
 
 
 __all__ = [
@@ -88,5 +104,6 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "counter", "gauge", "histogram",
     "format_labels", "MetricsReporter", "active", "attach",
     "prometheus_dump", "start_from_flags", "stop_global",
-    "trace", "http", "dump", "memory", "benchgate",
+    "trace", "http", "dump", "memory", "benchgate", "fleet",
+    "shutdown",
 ]

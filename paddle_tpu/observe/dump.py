@@ -43,7 +43,9 @@ def debug_dump(out_dir: Optional[str] = None) -> Tuple[str, str]:
     has drained at least once this process, its latest structured
     report is dumped alongside as ``.health.json`` (resolved through
     ``sys.modules`` — a run that never enabled ``--health_interval``
-    writes exactly the legacy two files)."""
+    writes exactly the legacy two files); when this process HOSTS a
+    fleet aggregator (``--fleet_port``), the cluster rollup + topology
+    land as ``.fleet.json`` too."""
     from ..utils import FLAGS
 
     out_dir = out_dir or FLAGS.get("debug_dump_dir") or "/tmp"
@@ -63,6 +65,15 @@ def debug_dump(out_dir: Optional[str] = None) -> Tuple[str, str]:
         with open(stem + ".health.json", "w") as f:
             json.dump({"report": health_report,
                        "summary": hmod.status_summary()}, f, indent=1)
+    # a process HOSTING the fleet aggregator dumps the cluster view
+    # too: the rollup + topology of every registered peer at dump time
+    # (resolved through sys.modules like health — the module is always
+    # imported with the package, the gate is whether it is hosting)
+    fmod = sys.modules.get("paddle_tpu.observe.fleet")
+    if fmod is not None and fmod.hosting():
+        with open(stem + ".fleet.json", "w") as f:
+            json.dump({"healthz": fmod.rollup(),
+                       "topology": fmod.topology()}, f, indent=1)
     return prom_path, trace_path
 
 

@@ -37,15 +37,18 @@ no-op.
 
 The handler never raises into the serving loop (telemetry never kills
 — a scrape that fails returns 500 with the error text), binds loopback
-only (metrics are not an external API), and every request runs on a
-short-lived daemon thread (``ThreadingHTTPServer``), so a slow scraper
-cannot wedge the trainer.
+by default (metrics are not an external API; ``--metrics_bind`` is an
+explicit, loudly-warned opt-in for same-host/container scraping on a
+trusted network — see :func:`resolve_bind_host`), and every request
+runs on a short-lived daemon thread (``ThreadingHTTPServer``), so a
+slow scraper cannot wedge the trainer.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import socket
 import sys
 import threading
 import time
@@ -58,6 +61,47 @@ from .report import prometheus_dump
 
 #: Serve-loop thread name (conftest thread-leak guard entry).
 SERVER_THREAD_NAME = "ptpu-metrics-http"
+
+#: Addresses that stay within the host (no warning needed).
+_LOOPBACK_HOSTS = ("", "127.0.0.1", "localhost", "::1")
+
+
+class _ThreadingHTTPServerV6(ThreadingHTTPServer):
+    address_family = socket.AF_INET6
+
+
+def make_threading_server(host: str, port: int,
+                          handler) -> ThreadingHTTPServer:
+    """A ``ThreadingHTTPServer`` bound to ``host:port``, picking the
+    address family from the host spelling — ``ThreadingHTTPServer`` is
+    AF_INET by default, so an IPv6 host (``::1``, ``::``) would always
+    fail to bind and silently disable the endpoint it serves."""
+    cls = _ThreadingHTTPServerV6 if ":" in host else ThreadingHTTPServer
+    return cls((host, port), handler)
+
+
+def resolve_bind_host(flag_name: str) -> str:
+    """Resolve a bind-address flag (``metrics_bind`` /
+    ``fleet_bind``): empty keeps the loopback default; anything else
+    is an EXPLICIT opt-in (cross-container scraping on a trusted
+    network) and logs a loud structured warning — these endpoints are
+    diagnostics, not an external API (no auth, no TLS, free trace and
+    metric disclosure to anyone who can connect)."""
+    from ..utils import FLAGS
+    from ..utils.logger import get_logger, warn_once
+
+    host = str(FLAGS.get(flag_name)).strip()
+    if host in _LOOPBACK_HOSTS:
+        return host or "127.0.0.1"
+    warn_once(
+        f"nonloopback_bind:{flag_name}:{host}",
+        "--%s=%s binds a telemetry endpoint BEYOND loopback: this is "
+        "a diagnostics surface, NOT an external API — no auth, no "
+        "TLS; metrics, traces and health detail are readable by "
+        "anyone who can reach the port.  Keep it inside a trusted "
+        "network boundary (pod/network-policy), never on a public "
+        "interface", flag_name, host, logger=get_logger("observe"))
+    return host
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -155,7 +199,7 @@ class ObservabilityServer:
     ``/health`` server thread."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = make_threading_server(host, port, _Handler)
         self._httpd.daemon_threads = True
         self._httpd.t0 = time.monotonic()
         self.port = self._httpd.server_address[1]
@@ -203,8 +247,9 @@ def start_from_flags() -> Optional[ObservabilityServer]:
         return _global
     with _global_lock:
         if _global is None:
+            host = resolve_bind_host("metrics_bind")
             try:
-                _global = ObservabilityServer(port).start()
+                _global = ObservabilityServer(port, host=host).start()
             except OSError as e:
                 warn_once(
                     f"metrics_port_bind_failed:{port}",
@@ -213,9 +258,9 @@ def start_from_flags() -> Optional[ObservabilityServer]:
                     port, e, logger=get_logger("observe"))
                 return None
             get_logger("observe").info(
-                "observability endpoint on http://127.0.0.1:%d "
+                "observability endpoint on http://%s:%d "
                 "(/metrics /healthz /trace /roofline /health)",
-                _global.port)
+                host, _global.port)
     return _global
 
 

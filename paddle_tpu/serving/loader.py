@@ -56,9 +56,9 @@ def _dequantize(q: np.ndarray, scale: np.ndarray, axis: int,
 # story) runs fine without it
 try:
     from ..observe import counter as _counter, histogram as _histogram
-    from ..observe import trace as _trace
+    from ..observe import fleet as _fleet, trace as _trace
 except ImportError:  # standalone copy: no package context
-    _counter = _histogram = _trace = None
+    _counter = _histogram = _trace = _fleet = None
 
 
 class ServedModel:
@@ -79,6 +79,10 @@ class ServedModel:
 
     @classmethod
     def load(cls, dirname: str) -> "ServedModel":
+        if _fleet is not None:
+            # a process loading a serving artifact pushes (when
+            # --fleet_addr is set) as role=serving; a dict write, free
+            _fleet.set_identity(role="serving")
         with open(os.path.join(dirname, "manifest.json")) as f:
             manifest = json.load(f)
         if manifest.get("format") != "paddle-tpu-serving":

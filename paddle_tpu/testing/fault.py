@@ -16,6 +16,11 @@ wall-clock or RNG draws — so chaos tests stay reproducible:
 - :func:`corrupt_checkpoint` — truncate or bit-flip a checkpoint file.
 - :func:`failing_saves` — make ``trainer.save`` raise a disk-full
   ``OSError`` for the next N calls.
+- :class:`FleetPusherProcess` — a telemetry-pushing "trainer" child
+  (real process, real fleet push client) that can be SIGKILLed,
+  SIGTERMed (exercising the graceful-shutdown flush) and restarted
+  under the same logical fleet id — the chaos driver for the fleet
+  observatory's staleness/recovery rollup.
 
 Everything is loopback/local-fs only; no real network is ever touched.
 """
@@ -171,6 +176,128 @@ class MasterServerProcess:
         self.proc = None
 
     def __enter__(self) -> "MasterServerProcess":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.kill()
+
+
+# ---------------------------------------------- fleet pusher processes
+# The child runs the REAL fleet push client (observe/fleet.py folded
+# into the reporter) against a REAL aggregator: it registers with its
+# role/pid/node identity, bumps a counter and closes one span per
+# tick (spans parented under an optional CTX header handed over by the
+# parent — the PR-8 cross-process propagation, so every process's
+# spans share one trace id on the merged /fleet/trace timeline), and
+# relies on the default SIGTERM hook for its goodbye frame.
+_PUSHER_SCRIPT = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+(addr, fleet_id, interval_s, parent_ctx, jsonl, role, trace_jsonl,
+ master_addr) = sys.argv[1:9]
+from paddle_tpu.utils import FLAGS
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+
+FLAGS.set("fleet_addr", addr)
+FLAGS.set("fleet_id", fleet_id)
+FLAGS.set("fleet_role", role)
+FLAGS.set("metrics_interval_s", float(interval_s))
+if jsonl:
+    FLAGS.set("metrics_jsonl", jsonl)
+if trace_jsonl:
+    FLAGS.set("trace_jsonl", trace_jsonl)
+trace.ensure_ring()          # ring-only: spans ride the push frames
+observe.start_from_flags()   # reporter + pusher + SIGTERM flush hook
+ctx = trace.parse_header(parent_ctx) if parent_ctx else None
+print("READY", os.getpid(), flush=True)
+step = 0
+with trace.span("child_pass", remote_parent=ctx, child=fleet_id):
+    if master_addr:          # one RPC: the C++ master echoes our CTX
+        from paddle_tpu.distributed.master import MasterClient
+        c = MasterClient(master_addr, retry_max=2)
+        c.ping()             # -> master_rpc + master.handle spans
+        c.close()
+    while True:
+        with trace.span("child_step", step=step, child=fleet_id):
+            observe.counter("fleet_child_steps_total",
+                            "chaos pusher ticks").inc()
+        step += 1
+        time.sleep(float(interval_s) / 4.0)
+"""
+
+
+class FleetPusherProcess:
+    """A real fleet-pushing child process for chaos tests.
+
+    ``start()`` spawns it and waits for the READY line (printed after
+    the first registration push), ``kill()`` SIGKILLs it (the
+    preemption model — no goodbye frame, the aggregator must notice
+    via staleness), ``terminate()`` SIGTERMs it (the orchestrator
+    grace path — the shutdown hook flushes and pushes the going-down
+    frame), and a later ``start()`` re-registers under the SAME
+    ``fleet_id``, flipping the rollup back to ok."""
+
+    def __init__(self, aggregator_addr: str, fleet_id: str,
+                 interval_s: float = 0.2, parent_ctx: str = "",
+                 jsonl_path: str = "", role: str = "trainer",
+                 trace_jsonl: str = "", master_addr: str = ""):
+        self.aggregator_addr = aggregator_addr
+        self.fleet_id = fleet_id
+        self.interval_s = interval_s
+        self.parent_ctx = parent_ctx
+        self.jsonl_path = jsonl_path
+        self.role = role
+        self.trace_jsonl = trace_jsonl
+        self.master_addr = master_addr
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self, ready_timeout_s: float = 60.0) -> "FleetPusherProcess":
+        assert self.proc is None or self.proc.poll() is not None, \
+            "pusher process already running"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _PUSHER_SCRIPT,
+             self.aggregator_addr, self.fleet_id, str(self.interval_s),
+             self.parent_ctx, self.jsonl_path, self.role,
+             self.trace_jsonl, self.master_addr],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = self.proc.stdout.readline()   # blocks until READY
+        assert line.startswith("READY"), \
+            f"pusher child failed to start: {line!r}"
+        return self
+
+    @property
+    def pid(self) -> int:
+        assert self.proc is not None
+        return self.proc.pid
+
+    def kill(self) -> None:
+        """SIGKILL — preemption: no shutdown hook runs, no goodbye
+        frame; the aggregator flips this process to 'missing' only
+        via staleness."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def terminate(self, wait_s: float = 30.0) -> int:
+        """SIGTERM — the orchestrator grace path: the default
+        shutdown hook flushes the final interval and pushes the
+        going-down frame, then the process dies BY the signal.
+        Returns the child's returncode (-SIGTERM on the default
+        disposition)."""
+        assert self.proc is not None
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            self.proc.wait(timeout=wait_s)
+        return self.proc.returncode
+
+    def __enter__(self) -> "FleetPusherProcess":
         return self.start()
 
     def __exit__(self, *exc) -> None:

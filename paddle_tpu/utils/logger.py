@@ -96,7 +96,13 @@ def warn_once(key: str, msg: str, *args,
     return True
 
 
-def reset_warn_once() -> None:
-    """Forget every warn_once key (tests)."""
+def reset_warn_once(key: Optional[str] = None) -> None:
+    """Forget every warn_once key (tests) — or, with ``key``, re-arm
+    just that one: a sink that RECOVERED from degradation wants its
+    failure warning to fire again on the next incident, not stay
+    silenced for the process lifetime."""
     with _warned_lock:
-        _warned.clear()
+        if key is None:
+            _warned.clear()
+        else:
+            _warned.discard(key)

@@ -177,10 +177,11 @@ def _reset_global_state(_io_thread_leak_guard):
     global_stat.reset()
     REGISTRY.reset()
     reset_warn_once()
-    # tracing + the HTTP endpoint are process-wide: a test that enabled
-    # them must not leak its recorder/server (threads) into the next
-    observe.trace.disable()
-    observe.http.stop_global()
+    # tracing + the HTTP endpoint + the fleet plane are process-wide: a
+    # test that enabled them must not leak its recorder/server/pusher/
+    # reporter (threads) or SIGTERM disposition into the next
+    observe.stop_global()        # reporter + http + fleet agg + trace
+    observe.fleet.reset_identity()
     # the training-health observatory keeps a process-wide latest
     # report for /health — resolved through sys.modules so tests that
     # never import it pay nothing
@@ -208,10 +209,12 @@ def _io_thread_leak_guard(request):
     import warnings
 
     from paddle_tpu.data.pipeline import IO_THREAD_PREFIX
+    from paddle_tpu.observe.fleet import AGGREGATOR_THREAD_NAME
     from paddle_tpu.observe.http import SERVER_THREAD_NAME
     from paddle_tpu.observe.trace import WRITER_THREAD_NAME
 
-    prefixes = (IO_THREAD_PREFIX, WRITER_THREAD_NAME, SERVER_THREAD_NAME)
+    prefixes = (IO_THREAD_PREFIX, WRITER_THREAD_NAME, SERVER_THREAD_NAME,
+                AGGREGATOR_THREAD_NAME)
 
     def stray():
         return [t for t in threading.enumerate()

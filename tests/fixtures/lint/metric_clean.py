@@ -43,3 +43,11 @@ def health_drain(layer, kind):
     observe.gauge("health_grad_norm").set(1.0, layer=layer)
     observe.counter("health_alerts_total").inc(kind=kind, layer=layer)
     observe.histogram("health_loss").observe(0.5)
+
+
+def fleet_registration(role, proc):
+    """Fleet registration/push shape: literal family names, the
+    per-process identity carried entirely in labels."""
+    observe.counter("fleet_frames_total").inc(role=role)
+    observe.gauge("fleet_procs").set(2.0)
+    observe.histogram("fleet_push_seconds").observe(0.002, proc=proc)

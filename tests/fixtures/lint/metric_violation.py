@@ -32,3 +32,7 @@ def echo(op):
 
 def health_alert(kind):
     observe.counter("health_" + kind + "_total").inc()   # line 34
+
+
+def fleet_push(role):
+    observe.gauge("fleet_last_push_" + role).set(0.0)    # line 38

@@ -57,3 +57,10 @@ def compute():
 
 def unknown_name():
     return "runtime-decided"
+
+
+def fleet_aggregator():
+    serve = threading.Thread(target=compute, name="ptpu-fleet-http")
+    push = threading.Thread(target=compute,
+                            name=THREAD_NAME_PREFIX + "fleet-push")
+    return serve, push

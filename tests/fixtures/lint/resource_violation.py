@@ -38,3 +38,8 @@ def spawn():
 
 def compute():
     return 0
+
+
+def fleet_aggregator():
+    serve = threading.Thread(target=silent, name="fleet-http")
+    return serve

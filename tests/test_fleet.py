@@ -1,0 +1,809 @@
+"""Fleet observatory tests (observe/fleet.py + the push client folded
+into the reporter + the SIGTERM graceful flush).
+
+Coverage map:
+
+- **staleness math** — pure :class:`FleetState` with a FAKE clock, no
+  sleeps: ok → missing at exactly stale_factor × interval, restart
+  (same logical id, new pid) flips back, down vs missing distinction;
+- **frame protocol** — schema rejection (version skew), non-frame
+  bodies, merged Prometheus rendering with role/pid/node/proc labels,
+  merged Chrome-trace timeline with per-process lanes + dedup;
+- **push client** — registration roundtrip, incremental span shipping,
+  degrade on dead/bare-ERR/version-skew peers with backoff, recovery,
+  the reporter fold (zero new threads with ``--fleet_addr`` unset);
+- **chaos** (3 real processes) — SIGKILL a pushing trainer → rollup
+  'missing' within the staleness window → restart under the same id →
+  rollup recovers; the run's ``/fleet/trace`` is strict Chrome JSON
+  with ≥ 2 distinct pids under ONE trace id;
+- **merged trace** — two pusher children + the C++ master's CTX echo
+  in one timeline (the ROADMAP item-3 wish, across four pids);
+- **SIGTERM** — a real child flushes its final interval and pushes the
+  going-down frame before dying BY the signal.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.observe import fleet, report, shutdown, trace
+from paddle_tpu.observe.fleet import (
+    FLEET_SCHEMA,
+    FleetAggregator,
+    FleetFrameError,
+    FleetPusher,
+    FleetSchemaError,
+    FleetState,
+)
+from paddle_tpu.utils import FLAGS
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _frame(name="trainer-0", role="trainer", pid=101, node="host-a",
+           interval_s=1.0, seq=0, schema=FLEET_SCHEMA, metrics=None,
+           spans=None, health=None, going_down=False, **extra):
+    f = {"schema": schema, "kind": "fleet-frame", "role": role,
+         "name": name, "node": node, "pid": pid, "seq": seq,
+         "ts": time.time(), "uptime_s": 1.0, "interval_s": interval_s,
+         "going_down": going_down,
+         "health": health or {"status": "ok"},
+         "metrics": metrics or [], "timers": [], "spans": spans or []}
+    f.update(extra)
+    return f
+
+
+def _span(pid=101, tid=1, ts=1.0, name="step", trace_id="t1",
+          span_id="s1", parent_id=None):
+    args = {"trace_id": trace_id, "span_id": span_id}
+    if parent_id:
+        args["parent_id"] = parent_id
+    return {"name": name, "ph": "X", "cat": "ptpu", "ts": ts,
+            "dur": 5.0, "pid": pid, "tid": tid, "args": args}
+
+
+# ---------------------------------------------------- staleness (fake clock)
+def test_staleness_flips_to_missing_and_back_on_restart():
+    clock = FakeClock()
+    st = FleetState(stale_factor=3.0, clock=clock)
+    st.ingest(_frame(interval_s=1.0, pid=101))
+    assert st.rollup()["status"] == "ok"
+
+    clock.advance(2.9)          # < 3 × interval: still ok
+    roll = st.rollup()
+    assert roll["status"] == "ok"
+    assert roll["procs"]["trainer-0"]["status"] == "ok"
+
+    clock.advance(0.2)          # > 3 × interval: missing
+    roll = st.rollup()
+    assert roll["status"] == "missing"
+    assert roll["procs"]["trainer-0"]["status"] == "missing"
+    assert roll["counts"]["missing"] == 1
+
+    # restart: SAME logical id, NEW pid — rollup recovers
+    st.ingest(_frame(interval_s=1.0, pid=202))
+    roll = st.rollup()
+    assert roll["status"] == "ok"
+    assert roll["procs"]["trainer-0"]["pid"] == 202
+    assert roll["procs"]["trainer-0"]["restarts"] == 1
+
+
+def test_staleness_scales_with_each_procs_own_interval():
+    clock = FakeClock()
+    st = FleetState(stale_factor=2.0, clock=clock)
+    st.ingest(_frame(name="fast", interval_s=0.5))
+    st.ingest(_frame(name="slow", interval_s=10.0, pid=102))
+    clock.advance(1.5)          # fast: 1.5 > 2×0.5 missing; slow fine
+    roll = st.rollup()
+    assert roll["procs"]["fast"]["status"] == "missing"
+    assert roll["procs"]["slow"]["status"] == "ok"
+    assert roll["status"] == "missing"
+
+
+def test_down_is_clean_and_does_not_degrade_cluster():
+    clock = FakeClock()
+    st = FleetState(stale_factor=3.0, clock=clock)
+    st.ingest(_frame(name="t-0"))
+    st.ingest(_frame(name="t-1", pid=102, going_down=True))
+    roll = st.rollup()
+    assert roll["procs"]["t-1"]["status"] == "down"
+    assert roll["status"] == "ok"       # a clean goodbye is not a fault
+    # a degraded peer DOES degrade the cluster; missing dominates
+    st.ingest(_frame(name="t-2", pid=103,
+                     health={"status": "degraded"}))
+    assert st.rollup()["status"] == "degraded"
+    clock.advance(100.0)
+    assert st.rollup()["status"] == "missing"
+
+
+def test_empty_fleet_reports_empty():
+    st = FleetState(clock=FakeClock())
+    assert st.rollup()["status"] == "empty"
+    assert st.rollup()["procs"] == {}
+
+
+# ------------------------------------------------------------ frame protocol
+def test_schema_version_skew_is_refused():
+    st = FleetState(clock=FakeClock())
+    with pytest.raises(FleetSchemaError):
+        st.ingest(_frame(schema=FLEET_SCHEMA + 1))
+    with pytest.raises(FleetFrameError):
+        st.ingest({"hello": "world"})
+    with pytest.raises(FleetFrameError):
+        st.ingest(_frame(schema="nope"))
+    # older schema is accepted (forward-compatible aggregator)
+    assert st.ingest(_frame(schema=0))["ok"] is True
+
+
+def test_merged_prometheus_carries_identity_labels():
+    st = FleetState(clock=FakeClock())
+    m = [{"name": "train_samples", "type": "counter", "help": "n",
+          "samples": [{"labels": {}, "value": 32.0}]}]
+    st.ingest(_frame(name="t-0", pid=101, node="a", metrics=m))
+    m2 = [{"name": "train_samples", "type": "counter", "help": "n",
+           "samples": [{"labels": {}, "value": 64.0}]}]
+    st.ingest(_frame(name="t-1", pid=102, node="b", role="serving",
+                     metrics=m2))
+    text = st.merged_prometheus()
+    assert text.count("# TYPE train_samples counter") == 1
+    assert ('train_samples{node="a",pid="101",proc="t-0",'
+            'role="trainer"} 32.0') in text
+    assert ('train_samples{node="b",pid="102",proc="t-1",'
+            'role="serving"} 64.0') in text
+
+
+def test_merged_prometheus_histogram_and_type_conflict():
+    st = FleetState(clock=FakeClock())
+    hist = [{"name": "step_seconds", "type": "histogram", "help": "h",
+             "samples": [{"labels": {}, "count": 3, "sum": 0.6,
+                          "buckets": [[0.1, 1], [0.5, 3], ["+Inf", 3]],
+                          "quantiles": {"p50": 0.2}}]}]
+    st.ingest(_frame(name="t-0", metrics=hist))
+    conflict = [{"name": "step_seconds", "type": "gauge", "help": "g",
+                 "samples": [{"labels": {}, "value": 1.0}]}]
+    st.ingest(_frame(name="t-1", pid=102, metrics=conflict))
+    text = st.merged_prometheus()
+    assert 'step_seconds_bucket{le="0.5"' in text
+    assert "step_seconds_sum{" in text and "step_seconds_count{" in text
+    assert 'step_seconds_q{' in text and 'quantile="0.50"' in text
+    # the conflicting gauge from t-1 is skipped, loudly
+    assert "skipped conflicting family" in text
+    assert 'proc="t-1"' not in text.split("# fleet:")[0]
+
+
+def test_merged_trace_lanes_and_dedup():
+    st = FleetState(clock=FakeClock())
+    s1 = _span(pid=101, span_id="a1", ts=10.0)
+    s2 = _span(pid=102, span_id="b1", ts=5.0, tid=2)
+    st.ingest(_frame(name="t-0", pid=101, spans=[s1]))
+    st.ingest(_frame(name="t-1", pid=102, spans=[s2]))
+    # re-pushing the same span (retry after a failed ack) dedups
+    st.ingest(_frame(name="t-0", pid=101, seq=1, spans=[s1]))
+    evs = json.loads(st.merged_trace_json())
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert {e["pid"] for e in meta} == {101, 102}
+    assert all(e["name"] == "process_name" for e in meta)
+    assert len(spans) == 2                      # dedup held
+    assert [e["args"]["span_id"] for e in spans] == ["b1", "a1"]  # by ts
+    # every event carries the PR-8 Chrome trace-event key schema
+    for e in evs:
+        for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+            assert key in e, f"event missing {key}: {e}"
+
+
+def test_aggregator_span_retention_is_bounded():
+    st = FleetState(clock=FakeClock(), ring_size=8)
+    spans = [_span(span_id=f"s{i}", ts=float(i)) for i in range(20)]
+    st.ingest(_frame(spans=spans))
+    held = [e for e in st.merged_trace_events() if e["ph"] == "X"]
+    assert len(held) == 8
+    assert held[0]["args"]["span_id"] == "s12"   # newest kept
+
+
+def test_restart_keeps_predecessors_spans_for_forensics():
+    st = FleetState(clock=FakeClock())
+    st.ingest(_frame(pid=101, spans=[_span(pid=101, span_id="old")]))
+    st.ingest(_frame(pid=202, spans=[_span(pid=202, span_id="new",
+                                           ts=2.0)]))
+    ids = [e["args"]["span_id"] for e in st.merged_trace_events()
+           if e["ph"] == "X"]
+    # the killed incarnation's timeline survives the restart (ring-
+    # bounded): "what was trainer-0 doing before it died" stays
+    # answerable; the metadata lane reflects the LIVE pid
+    assert ids == ["old", "new"]
+    meta = [e for e in st.merged_trace_events() if e["ph"] == "M"]
+    assert {e["pid"] for e in meta} == {202}
+
+
+# -------------------------------------------------------------- watch console
+def test_watch_rows_and_render():
+    st = FleetState(clock=FakeClock())
+    m = [{"name": "train_samples_per_sec", "type": "gauge", "help": "",
+          "samples": [{"labels": {}, "value": 123.4}]},
+         {"name": "input_bound_ratio", "type": "gauge", "help": "",
+          "samples": [{"labels": {}, "value": 0.02}]},
+         {"name": "hbm_peak_bytes", "type": "gauge", "help": "",
+          "samples": [{"labels": {}, "value": 2 * 1024 ** 3}]}]
+    st.ingest(_frame(metrics=m))
+    rows = st.watch_rows()
+    assert rows[0]["steps_per_s"] == pytest.approx(123.4)
+    assert rows[0]["input_bound"] == pytest.approx(0.02)
+    text = fleet.render_watch(st.rollup(), rows)
+    assert "trainer-0" in text and "123.4" in text and "2.0GB" in text
+    assert text.splitlines()[0].startswith("fleet: ok")
+
+
+# ------------------------------------------------------ pusher ↔ aggregator
+def test_pusher_registration_and_incremental_spans():
+    with FleetAggregator(0) as agg:
+        trace.ensure_ring()
+        with trace.span("pass_a"):
+            pass
+        p = FleetPusher(agg.addr, interval_s=0.5)
+        assert p.push() is True
+        held = [e for e in agg.state.merged_trace_events()
+                if e["ph"] == "X"]
+        assert {e["name"] for e in held} == {"pass_a"}
+        # second push ships only NEW spans (high-water mark advanced)
+        with trace.span("pass_b"):
+            pass
+        assert p.push() is True
+        topo = agg.state.topology()
+        (entry,) = topo["procs"].values()
+        assert entry["frames"] == 2 and entry["seq"] == 1
+        held = [e["name"] for e in agg.state.merged_trace_events()
+                if e["ph"] == "X"]
+        assert sorted(held) == ["pass_a", "pass_b"]
+
+
+def test_pusher_identity_resolution(monkeypatch):
+    p = FleetPusher("127.0.0.1:1")
+    frame = p.build_frame()
+    assert frame["role"] == "trainer"           # flag default
+    assert frame["name"].startswith("trainer@")
+    fleet.set_identity(role="serving", name="server-1")
+    frame = p.build_frame()
+    assert frame["role"] == "serving" and frame["name"] == "server-1"
+    assert frame["schema"] == FLEET_SCHEMA
+    assert frame["pid"] == os.getpid()
+
+
+def test_pusher_degrades_on_dead_peer_and_recovers():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()                    # free it: nothing listens now
+    p = FleetPusher(f"127.0.0.1:{port}", interval_s=0.1)
+    assert p.push() is False
+    assert p.degraded and p.failures == 1
+    assert p.maybe_push() is None   # inside the backoff window
+    # the aggregator comes back on the same port: recovery clears state
+    agg = FleetAggregator(port)
+    agg.start()
+    try:
+        p._skip_until = 0.0
+        assert p.push() is True
+        assert not p.degraded and p.failures == 0
+    finally:
+        agg.stop()
+
+
+def test_pusher_degrades_on_bare_err_body():
+    """A peer speaking a different dialect answers 200 with a non-JSON
+    body — the version-skew/bare-ERR case must degrade the push sink
+    exactly like a failing JSONL flush, never raise."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def bad_peer():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: 3\r\n"
+                     b"Connection: close\r\n\r\nERR")
+        conn.close()
+
+    t = threading.Thread(target=bad_peer, name="ptpu-test-badpeer",
+                         daemon=True)
+    t.start()
+    try:
+        p = FleetPusher(f"127.0.0.1:{port}", interval_s=0.1)
+        assert p.push() is False
+        assert p.degraded
+    finally:
+        t.join(timeout=5.0)
+        srv.close()
+
+
+def test_pusher_degrades_on_schema_rejection(monkeypatch):
+    with FleetAggregator(0) as agg:
+        p = FleetPusher(agg.addr, interval_s=0.1)
+        real = p.build_frame
+
+        def future_frame(**kw):
+            f = real(**kw)
+            f["schema"] = FLEET_SCHEMA + 7
+            return f
+
+        monkeypatch.setattr(p, "build_frame", future_frame)
+        assert p.push() is False
+        assert p.degraded
+        assert agg.state.rollup()["status"] == "empty"  # refused
+
+
+def test_aggregator_http_endpoints():
+    import http.client
+
+    with FleetAggregator(0) as agg:
+        observe.counter("fleet_test_ticks", "endpoint fixture").inc()
+        FleetPusher(agg.addr, interval_s=0.2).push()
+
+        def get(path):
+            conn = http.client.HTTPConnection("127.0.0.1", agg.port,
+                                              timeout=5)
+            conn.request("GET", path)
+            r = conn.getresponse()
+            body = r.read()
+            conn.close()
+            return r.status, body
+
+        code, body = get("/fleet/healthz")
+        assert code == 200
+        assert json.loads(body)["status"] == "ok"
+        code, body = get("/fleet/topology")
+        assert code == 200 and json.loads(body)["procs"]
+        code, body = get("/fleet/metrics")
+        assert code == 200 and b"# TYPE" in body
+        code, body = get("/fleet/trace")
+        assert code == 200 and isinstance(json.loads(body), list)
+        code, body = get("/nope")
+        assert code == 404 and "paths" in json.loads(body)
+        # POST intake guards
+        conn = http.client.HTTPConnection("127.0.0.1", agg.port,
+                                          timeout=5)
+        conn.request("POST", "/fleet/push", body=b"this is not json")
+        r = conn.getresponse()
+        assert r.status == 400
+        assert json.loads(r.read())["schema"] == FLEET_SCHEMA
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", agg.port,
+                                          timeout=5)
+        conn.request("POST", "/fleet/push",
+                     body=json.dumps(_frame(schema=FLEET_SCHEMA + 1)))
+        r = conn.getresponse()
+        assert r.status == 400 and b"newer" in r.read()
+        conn.close()
+
+
+# ------------------------------------------------- reporter fold + flags
+def test_reporter_folds_pusher_and_sends_goodbye(tmp_path):
+    with FleetAggregator(0) as agg:
+        jsonl = str(tmp_path / "m.jsonl")
+        r = report.MetricsReporter(path=jsonl, interval_s=0.05,
+                                   fleet_addr=agg.addr)
+        assert r.fleet is not None
+        r.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and not agg.state.topology()["procs"]:
+            time.sleep(0.02)
+        r.stop()                         # final line + going-down frame
+        topo = agg.state.topology()
+        (entry,) = topo["procs"].values()
+        assert entry["going_down"] is True
+        assert agg.state.rollup()["status"] == "ok"   # clean down
+        with open(jsonl) as f:
+            assert len(f.read().splitlines()) >= 1
+
+
+def test_no_fleet_addr_means_no_threads_no_reporter():
+    assert FLAGS.get("fleet_addr") == ""
+    assert report.start_from_flags() is None
+    assert not any(t.name == "ptpu-metrics-reporter"
+                   for t in threading.enumerate())
+    assert fleet.start_from_flags() is None
+    assert not fleet.hosting()
+
+
+def test_start_from_flags_fleet_addr_only(tmp_path):
+    with FleetAggregator(0) as agg:
+        FLAGS.set("fleet_addr", agg.addr)
+        FLAGS.set("metrics_interval_s", 0.05)
+        try:
+            r = report.start_from_flags()
+            assert r is not None and r.fleet is not None
+            assert r.path is None        # no JSONL sink configured
+            # a healthy fleet pusher IS a live sink: the fenced
+            # headline metrics (samples/sec, time split) are what the
+            # aggregator's watch console renders
+            assert observe.active()
+            # the startup probe push registered us immediately
+            assert agg.state.topology()["procs"]
+        finally:
+            FLAGS.set("fleet_addr", "")
+            FLAGS.set("metrics_interval_s", 10.0)
+            report.stop_global()
+
+
+def test_hosted_aggregator_from_flags_and_fleet_dump(tmp_path):
+    FLAGS.set("fleet_port", 0)
+    assert fleet.start_from_flags() is None     # 0 = off
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    FLAGS.set("fleet_port", port)
+    try:
+        agg = fleet.start_from_flags()
+        assert agg is not None and fleet.hosting()
+        assert fleet.start_from_flags() is agg  # idempotent
+        FleetPusher(agg.addr, interval_s=0.2).push()
+        # SIGUSR2 debug dump gains the .fleet.json artifact
+        from paddle_tpu.observe import dump as odump
+        prom, tr = odump.debug_dump(str(tmp_path))
+        fleet_paths = [p for p in os.listdir(tmp_path)
+                       if p.endswith(".fleet.json")]
+        assert len(fleet_paths) == 1
+        with open(tmp_path / fleet_paths[0]) as f:
+            doc = json.load(f)
+        assert doc["healthz"]["status"] == "ok"
+        assert doc["topology"]["procs"]
+    finally:
+        FLAGS.set("fleet_port", 0)
+        fleet.stop_global()
+
+
+def test_debug_dump_without_aggregator_writes_no_fleet_artifact(tmp_path):
+    from paddle_tpu.observe import dump as odump
+
+    odump.debug_dump(str(tmp_path))
+    assert not [p for p in os.listdir(tmp_path)
+                if p.endswith(".fleet.json")]
+
+
+def test_metrics_bind_nonloopback_warns():
+    import logging
+
+    from paddle_tpu.observe.http import resolve_bind_host
+
+    assert resolve_bind_host("metrics_bind") == "127.0.0.1"
+    hits = []
+
+    class Grab(logging.Handler):
+        def emit(self, record):
+            hits.append(record.getMessage())
+
+    h = Grab()
+    logging.getLogger("paddle_tpu").addHandler(h)
+    FLAGS.set("metrics_bind", "0.0.0.0")
+    try:
+        host = resolve_bind_host("metrics_bind")
+        assert host == "0.0.0.0"
+        assert any("NOT an external API" in m for m in hits)
+        # loud but once: the opt-in is deliberate, not per-scrape noise
+        resolve_bind_host("metrics_bind")
+        assert sum("NOT an external API" in m for m in hits) == 1
+    finally:
+        FLAGS.set("metrics_bind", "")
+        logging.getLogger("paddle_tpu").removeHandler(h)
+
+
+# ------------------------------------------------------ chaos (3 processes)
+def _wait_for(pred, timeout_s=20.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_kill_restart_and_merged_trace(tmp_path):
+    """THE acceptance pin: aggregator + two pushing trainer processes;
+    SIGKILL one → /fleet/healthz reports it missing within the
+    staleness window; restart under the same fleet id → rollup returns
+    to ok; the run's /fleet/trace is valid Chrome trace JSON with
+    spans from ≥ 2 distinct pids under one propagated trace id."""
+    from paddle_tpu.testing import fault
+
+    trace.ensure_ring()
+    with FleetAggregator(0) as agg:
+        with trace.span("fleet_pass") as root:
+            ctx = trace.parent_header()
+            assert ctx
+        t0 = fault.FleetPusherProcess(agg.addr, "trainer-0",
+                                      interval_s=0.2, parent_ctx=ctx)
+        t1 = fault.FleetPusherProcess(agg.addr, "trainer-1",
+                                      interval_s=0.2, parent_ctx=ctx)
+        with t0, t1:
+            _wait_for(lambda: set(agg.state.rollup()["procs"])
+                      >= {"trainer-0", "trainer-1"},
+                      what="both trainers registered")
+            _wait_for(lambda: agg.state.rollup()["status"] == "ok",
+                      what="rollup ok with both trainers")
+            killed_pid = t0.pid
+            survivor_pid = t1.pid
+
+            def span_pids():
+                return {e["pid"]
+                        for e in agg.state.merged_trace_events()
+                        if e["ph"] == "X"
+                        and e["args"].get("trace_id")
+                        == root.context.trace_id}
+
+            # both trainers must have SHIPPED spans of the shared
+            # trace before the kill — the timeline must already hold
+            # the victim's last moments
+            _wait_for(lambda: {killed_pid, survivor_pid}
+                      <= span_pids(),
+                      what="spans from both pids pushed")
+
+            # --- SIGKILL: no goodbye; staleness must notice
+            t0.kill()
+            _wait_for(lambda: agg.state.rollup()["procs"]
+                      ["trainer-0"]["status"] == "missing",
+                      timeout_s=0.2 * 3 * 4 + 10.0,
+                      what="killed trainer flagged missing")
+            roll = agg.state.rollup()
+            assert roll["status"] == "missing"
+            assert roll["procs"]["trainer-1"]["status"] == "ok"
+
+            # --- restart under the SAME id: rollup recovers
+            t0.start()
+            _wait_for(lambda: agg.state.rollup()["status"] == "ok",
+                      what="rollup recovered after restart")
+            roll = agg.state.rollup()
+            assert roll["procs"]["trainer-0"]["status"] == "ok"
+            assert roll["procs"]["trainer-0"]["pid"] != killed_pid
+            assert roll["procs"]["trainer-0"]["restarts"] >= 1
+
+            # --- merged trace: strict JSON over HTTP, ≥ 2 pids, ONE
+            #     trace id (the parent ctx both children adopted)
+            raw = fleet._http_get(agg.addr, "/fleet/trace")
+            evs = json.loads(raw)
+            spans = [e for e in evs if e["ph"] == "X"]
+            in_trace = [e for e in spans
+                        if e["args"].get("trace_id")
+                        == root.context.trace_id]
+            pids = {e["pid"] for e in in_trace}
+            assert killed_pid in pids      # the victim's last moments
+            assert survivor_pid in pids
+            assert len(pids) >= 2
+            for e in evs:
+                for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+                    assert key in e
+
+
+@pytest.mark.chaos
+def test_merged_trace_with_master_ctx_echo(tmp_path):
+    """Satellite 4: two pusher children + the C++ master's CTX echo in
+    ONE strict-JSON Chrome trace — spans from the two child pids AND
+    the master's pid (via the server-measured ``master.handle`` echo
+    spans) share the parent's trace id on one timeline."""
+    from paddle_tpu.testing import fault
+
+    trace.ensure_ring()
+    master = fault.MasterServerProcess(str(tmp_path / "snap"),
+                                       timeout_s=5)
+    with master, FleetAggregator(0) as agg:
+        with trace.span("export_pass") as root:
+            ctx = trace.parent_header()
+        kids = [fault.FleetPusherProcess(
+                    agg.addr, f"echo-{i}", interval_s=0.2,
+                    parent_ctx=ctx, master_addr=master.addr)
+                for i in range(2)]
+        with kids[0], kids[1]:
+            def has_echoes():
+                evs = agg.state.merged_trace_events()
+                handles = [e for e in evs
+                           if e.get("name") == "master.handle"]
+                return len(handles) >= 2
+            _wait_for(has_echoes, what="master.handle echoes pushed")
+            doc = json.loads(agg.state.merged_trace_json())
+            spans = [e for e in doc if e["ph"] == "X"]
+            same_trace = [e for e in spans
+                          if e["args"].get("trace_id")
+                          == root.context.trace_id]
+            pids = {e["pid"] for e in same_trace}
+            # two children + the master child = ≥ 3 distinct pids
+            assert {kids[0].pid, kids[1].pid} <= pids
+            assert master.proc.pid in pids
+            names = {e["name"] for e in same_trace}
+            assert {"child_step", "master_rpc",
+                    "master.handle"} <= names
+            # PR-8 schema round-trip over the whole merged document
+            for e in doc:
+                for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+                    assert key in e
+
+
+@pytest.mark.chaos
+def test_sigterm_child_flushes_final_interval_and_goodbye(tmp_path):
+    """Satellite 1: a SIGTERM'd process (the orchestrator-kill path)
+    must not lose its last telemetry interval — the chaining SIGTERM
+    hook flushes the final JSONL line, finalizes the trace array, and
+    pushes the going-down fleet frame; the child still dies BY the
+    signal (returncode -SIGTERM)."""
+    import signal as _signal
+
+    from paddle_tpu.testing import fault
+
+    jsonl = str(tmp_path / "child.jsonl")
+    trace_jsonl = str(tmp_path / "child.trace.json")
+    with FleetAggregator(0) as agg:
+        # LONG interval: nothing would flush before the SIGTERM — any
+        # line beyond the startup probe proves the shutdown hook ran
+        child = fault.FleetPusherProcess(
+            agg.addr, "doomed", interval_s=60.0, jsonl_path=jsonl,
+            trace_jsonl=trace_jsonl)
+        with child:
+            pid = child.pid
+            _wait_for(lambda: "doomed" in agg.state.topology()["procs"],
+                      what="child registered")
+            with open(jsonl) as f:
+                lines_before = len(f.read().splitlines())
+            rc = child.terminate()
+        assert rc == -_signal.SIGTERM          # died BY the signal
+        with open(jsonl) as f:
+            lines = [json.loads(ln) for ln in f.read().splitlines()]
+        assert len(lines) > lines_before       # the final flush landed
+        assert lines[-1]["seq"] == len(lines) - 1
+        # the aggregator saw the goodbye: down, NOT missing-later
+        entry = agg.state.topology()["procs"]["doomed"]
+        assert entry["going_down"] is True and entry["pid"] == pid
+        assert agg.state.rollup()["procs"]["doomed"]["status"] == "down"
+        # the --trace_jsonl array was finalized: strict JSON
+        with open(trace_jsonl) as f:
+            evs = json.load(f)
+        assert isinstance(evs, list) and len(evs) >= 1
+        assert any(e["name"] == "child_step" for e in evs)
+
+
+def test_sigterm_hook_chains_previous_handler():
+    """install_from_flags chains: a user handler installed BEFORE the
+    hook still runs after the flush (in-process, no child)."""
+    import signal as _signal
+
+    seen = []
+    prev = _signal.signal(_signal.SIGTERM,
+                          lambda s, f: seen.append(s))
+    try:
+        trace.ensure_ring()            # a surface to flush
+        assert shutdown.install_from_flags() is True
+        assert shutdown.installed()
+        os.kill(os.getpid(), _signal.SIGTERM)
+        deadline = time.monotonic() + 10.0
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.02)           # flush thread + re-raise
+        assert seen == [_signal.SIGTERM]
+        assert not trace.enabled()     # trace sink finalized
+    finally:
+        shutdown.uninstall()
+        _signal.signal(_signal.SIGTERM, prev)
+
+
+def test_sigterm_hook_not_installed_without_surfaces():
+    assert not trace.enabled()
+    assert report._global is None and not fleet.hosting()
+    assert shutdown.install_from_flags() is False \
+        or not shutdown.installed()
+
+
+# -------------------------------------------------- review regressions
+def test_active_false_when_fleet_pusher_degraded():
+    r = report.MetricsReporter(path=None, interval_s=0.1,
+                               fleet_addr="127.0.0.1:1")
+    try:
+        report._global = r
+        assert observe.active()          # healthy pusher = live sink
+        assert r.fleet.push() is False   # dead peer degrades it
+        assert not observe.active()      # nobody is listening anymore
+    finally:
+        report._global = None
+
+
+def test_malformed_fleet_addr_degrades_not_crashes():
+    """telemetry never kills: a typo'd --fleet_addr must warn and run
+    without a push client, not raise out of start_from_flags."""
+    r = report.MetricsReporter(path=None, interval_s=0.1,
+                               fleet_addr="somehost-no-port")
+    assert r.fleet is None               # warned, disabled
+    FLAGS.set("fleet_addr", "host:")     # the flag path too
+    try:
+        rep = report.start_from_flags()
+        assert rep is not None and rep.fleet is None
+    finally:
+        FLAGS.set("fleet_addr", "")
+        report.stop_global()
+
+
+def test_long_span_straddling_push_boundary_still_ships():
+    """The span high-water mark is END time: a long span that STARTED
+    before the last push but completed after must land in the next
+    frame (it records at exit with ts = its start)."""
+    with FleetAggregator(0) as agg:
+        trace.ensure_ring()
+        p = FleetPusher(agg.addr, interval_s=0.5)
+        with trace.span("long_rpc"):         # starts FIRST...
+            with trace.span("short"):
+                pass
+            assert p.push() is True          # ships only `short`
+        # ...completes after the push, with an earlier start ts
+        assert p.push() is True
+        names = sorted(e["name"] for e in
+                       agg.state.merged_trace_events()
+                       if e["ph"] == "X")
+        assert names == ["long_rpc", "short"]
+
+
+def test_aggregator_addr_reflects_bind_host():
+    with FleetAggregator(0, host="") as agg:        # wildcard bind
+        assert agg.addr == f"127.0.0.1:{agg.port}"  # connectable
+    with FleetAggregator(0, host="127.0.0.1") as agg:
+        assert agg.addr.startswith("127.0.0.1:")
+
+
+def test_ipv6_loopback_bind_supported():
+    from paddle_tpu.observe.http import make_threading_server
+
+    try:
+        srv = make_threading_server("::1", 0, object)
+    except OSError:
+        pytest.skip("no IPv6 loopback in this environment")
+    assert srv.address_family == socket.AF_INET6
+    srv.server_close()
+
+
+def test_topology_health_distinct_from_liveness():
+    clock = FakeClock()
+    st = FleetState(stale_factor=3.0, clock=clock)
+    st.ingest(_frame(health={"status": "degraded"}))
+    clock.advance(100.0)                 # long silent: missing now
+    assert st.rollup()["procs"]["trainer-0"]["status"] == "missing"
+    # ...but its last-known health verdict is still readable
+    assert st.topology()["procs"]["trainer-0"]["health"] == "degraded"
+
+
+# ------------------------------------------------------------ fleet smoke
+def test_fleet_smoke_in_process():
+    """Tier-1 smoke without child processes: one aggregator, two
+    simulated pushers (distinct identities via raw frames), rollup +
+    merged surfaces all coherent."""
+    with FleetAggregator(0) as agg:
+        import http.client
+
+        for i, frame in enumerate([
+                _frame(name="t-0", pid=111,
+                       spans=[_span(pid=111, span_id="x")]),
+                _frame(name="t-1", pid=222, role="serving",
+                       spans=[_span(pid=222, span_id="y")])]):
+            conn = http.client.HTTPConnection("127.0.0.1", agg.port,
+                                              timeout=5)
+            conn.request("POST", "/fleet/push", body=json.dumps(frame))
+            ack = json.loads(conn.getresponse().read())
+            conn.close()
+            assert ack["ok"] is True and ack["procs"] == i + 1
+        roll = agg.state.rollup()
+        assert roll["status"] == "ok" and len(roll["procs"]) == 2
+        assert observe.counter("fleet_frames_total").value(
+            role="trainer") == 1.0
+        assert observe.gauge("fleet_procs").value() == 2.0
+        console = fleet.watch_once(agg.addr)
+        assert "t-0" in console and "t-1" in console

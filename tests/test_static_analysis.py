@@ -90,7 +90,7 @@ def test_recompile_fixture_suppressed_and_clean():
 
 def test_resource_fixture_catches_every_hygiene_class():
     res = _run_one("resource_violation.py", rules=["PT-RESOURCE"])
-    assert _lines(res, "PT-RESOURCE") == [8, 12, 16, 25, 29, 34, 35]
+    assert _lines(res, "PT-RESOURCE") == [8, 12, 16, 25, 29, 34, 35, 44]
     by_line = {f.line: f.message for f in res.findings}
     assert "manual __enter__" in by_line[8]
     assert "manual __exit__" in by_line[12]
@@ -99,6 +99,9 @@ def test_resource_fixture_catches_every_hygiene_class():
     assert "bare `except:`" in by_line[29]
     assert "'worker-1' lacks the 'ptpu-' prefix" in by_line[34]
     assert "without a name=" in by_line[35]
+    # the fleet-aggregator serve-thread shape (round 17): an unprefixed
+    # HTTP serve-loop thread escapes the conftest leak guard
+    assert "'fleet-http' lacks the 'ptpu-' prefix" in by_line[44]
 
 
 def test_resource_fixture_suppressed_and_clean():
@@ -152,9 +155,9 @@ def test_metric_fixture_catches_every_dynamic_name_class():
     assert all(f.rule == "PT-METRIC" for f in res.findings)
     # f-string counter, concatenated histogram, variable through the
     # imported shim, %-format on REGISTRY, f-string span, call-result
-    # record_span, concatenated health-alert family — one per
-    # line-pinned site
-    assert _lines(res, "PT-METRIC") == [9, 13, 17, 21, 25, 30, 34]
+    # record_span, concatenated health-alert family, concatenated
+    # fleet-push family — one per line-pinned site
+    assert _lines(res, "PT-METRIC") == [9, 13, 17, 21, 25, 30, 34, 38]
     by_line = {f.line: f.message for f in res.findings}
     assert "an f-string" in by_line[9]
     assert "concatenation" in by_line[13]
@@ -162,6 +165,7 @@ def test_metric_fixture_catches_every_dynamic_name_class():
     assert by_line[25].startswith("span name")
     assert "a call result" in by_line[30]
     assert "concatenation" in by_line[34]
+    assert "concatenation" in by_line[38]     # fleet push site (r17)
     assert "labels" in by_line[9] and "span attrs" in by_line[25]
 
 
@@ -350,7 +354,7 @@ def test_cli_json_and_rule_selection(capsys):
     assert rc == 1
     data = json.loads(capsys.readouterr().out)
     assert {f["rule"] for f in data["findings"]} == {"PT-RESOURCE"}
-    assert len(data["findings"]) == 7
+    assert len(data["findings"]) == 8
 
 
 def test_cli_baseline_roundtrip(tmp_path, capsys):
