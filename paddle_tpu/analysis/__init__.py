@@ -18,12 +18,26 @@ instead of by convention:
 - **PT-LOCK**     deadlock analysis: the cross-module lock-acquisition
                   graph derived from ``with lock:`` nesting must stay
                   acyclic (plus the runtime checker in
-                  :mod:`paddle_tpu.analysis.lockorder`).
+                  :mod:`paddle_tpu.analysis.lockorder`);
+- **PT-SHAPE**    config-time shape/dtype verification: the
+                  :mod:`~paddle_tpu.analysis.netcheck` abstract
+                  interpreter over literal DSL model configs (the
+                  runtime half verifies whole ``ModelConfig``s and
+                  powers the ``dryrun_multichip`` preflight);
+- **PT-SHARD**    sharding-rule verification: broken literal
+                  ``ShardingRules`` tables statically, and (runtime
+                  half) unmatched/ambiguous params, rank and
+                  mesh-divisibility per topology;
+- **PT-RACE**     cross-thread shared-state races: attributes/globals
+                  reachable from two ``ptpu-*`` thread entrypoints
+                  with a write and no common ``named_lock`` guard
+                  (:mod:`~paddle_tpu.analysis.racecheck`).
 
 Run it::
 
     python -m paddle_tpu.analysis [paths] [--format text|json]
                                   [--baseline FILE] [--lock-graph]
+                                  [--rules ...] [--list-rules]
 
 Suppress a single deliberate finding with a justified pragma on the
 same line (or the line above)::
@@ -40,4 +54,4 @@ checker's ``named_lock`` indirection) at interpreter startup, which
 must not pay for the analyzer's AST machinery.
 """
 
-__all__ = ["engine", "lockorder"]
+__all__ = ["engine", "lockorder", "netcheck", "racecheck"]

@@ -26,9 +26,9 @@ def _default_paths() -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="ptpu-lint: framework-invariant static analysis "
-                    "(PT-TRACE, PT-RECOMPILE, PT-RESOURCE, PT-DTYPE, "
-                    "PT-LOCK, PT-METRIC)")
+        description="ptpu-lint + ptpu-verify: framework-invariant "
+                    "static analysis (see --list-rules for the rule "
+                    "catalog)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to analyze (default: the installed "
                         "paddle_tpu package)")
@@ -45,7 +45,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--lock-graph", action="store_true",
                    help="print the derived lock-acquisition graph / "
                         "hierarchy (PT-LOCK's model) and exit")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every rule id with its one-line "
+                        "description and exit")
     args = p.parse_args(argv)
+
+    if args.list_rules:
+        from .rules import RULE_DOCS
+
+        width = max(len(c) for c in engine.RULE_CODES)
+        for code in engine.RULE_CODES:
+            print(f"{code:<{width}}  {RULE_DOCS.get(code, '')}")
+        return 0
 
     paths = args.paths or _default_paths()
     for path in paths:

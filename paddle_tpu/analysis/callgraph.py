@@ -14,8 +14,30 @@ the cost of not seeing through duck-typed attribute calls.
 from __future__ import annotations
 
 import ast
+import hashlib
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ------------------------------------------------------------ parse cache
+#: Indexed modules keyed by (abs path, content sha256): the tier-1 repo
+#: sweep, the lock-graph dump, and every engine.run in one process
+#: parse each file exactly ONCE for its content — rules always shared a
+#: Project within a run; this shares the parse across runs too, so the
+#: sweep cost stays flat as the rule count grows.  An edited file (new
+#: hash) re-parses; the stale entry ages out at the next clear.
+_MODULE_CACHE: Dict[Tuple[str, str], "ModuleInfo"] = {}
+_MODULE_CACHE_MAX = 4096
+
+#: Observability for the single-parse property (pinned by a test):
+#: ``parses`` counts real ast.parse calls, ``cache_hits`` counts
+#: content-hash reuses.
+parse_stats = {"parses": 0, "cache_hits": 0}
+
+
+def clear_parse_cache() -> None:
+    _MODULE_CACHE.clear()
+    parse_stats["parses"] = 0
+    parse_stats["cache_hits"] = 0
 
 # ------------------------------------------------------------------ data
 
@@ -86,6 +108,7 @@ class ModuleInfo:
         self.is_package = is_package   # an __init__.py (name = the package)
         self.tree = tree
         self.source = source
+        self.content_hash = ""      # sha256 of source (parse-cache key)
         self.lines = source.splitlines()
         self.imports: Dict[str, str] = {}        # alias -> dotted module
         self.from_imports: Dict[str, Tuple[str, str]] = {}  # n -> (mod, orig)
@@ -234,12 +257,26 @@ class Project:
         try:
             with open(path, "r", encoding="utf-8") as f:
                 source = f.read()
-            tree = ast.parse(source, filename=path)
-        except (OSError, SyntaxError, ValueError):
+        except (OSError, ValueError):
             return None
-        name, is_package = _module_name_for(path)
-        mod = ModuleInfo(path, name, tree, source, is_package)
-        _Indexer(mod).visit(tree)
+        sha = hashlib.sha256(source.encode("utf-8", "replace")) \
+            .hexdigest()
+        mod = _MODULE_CACHE.get((path, sha))
+        if mod is None:
+            try:
+                tree = ast.parse(source, filename=path)
+            except (SyntaxError, ValueError):
+                return None
+            parse_stats["parses"] += 1
+            name, is_package = _module_name_for(path)
+            mod = ModuleInfo(path, name, tree, source, is_package)
+            mod.content_hash = sha
+            _Indexer(mod).visit(tree)
+            if len(_MODULE_CACHE) >= _MODULE_CACHE_MAX:
+                _MODULE_CACHE.clear()       # simple bound; re-warm
+            _MODULE_CACHE[(path, sha)] = mod
+        else:
+            parse_stats["cache_hits"] += 1
         # first registration wins the NAME (the import-resolution key);
         # the file is analyzed either way — rules iterate by path
         self.modules.setdefault(mod.name, mod)

@@ -25,7 +25,7 @@ from .callgraph import ModuleInfo, Project
 
 #: Every rule family, in report order.
 RULE_CODES = ("PT-TRACE", "PT-RECOMPILE", "PT-RESOURCE", "PT-DTYPE",
-              "PT-LOCK", "PT-METRIC")
+              "PT-LOCK", "PT-METRIC", "PT-SHAPE", "PT-SHARD", "PT-RACE")
 
 _PRAGMA_RE = re.compile(
     r"#\s*ptpu:\s*lint-ok\[([A-Za-z0-9_, \-]+)\]")
@@ -60,6 +60,25 @@ class Finding:
     def render(self, root: Optional[str] = None) -> str:
         return (f"{self.relpath(root)}:{self.line}:{self.col}: "
                 f"{self.rule} {self.message}")
+
+
+#: Pragma tables keyed by file content hash — tokenizing is the other
+#: per-file cost the repo sweep pays; cached alongside the parse cache
+#: (callgraph._MODULE_CACHE) so repeated runs tokenize each file once.
+_PRAGMA_CACHE: Dict[str, Dict[int, Set[str]]] = {}
+_PRAGMA_CACHE_MAX = 4096
+
+
+def _pragmas_for(mod: ModuleInfo) -> Dict[int, Set[str]]:
+    key = getattr(mod, "content_hash", "")
+    if key and key in _PRAGMA_CACHE:
+        return _PRAGMA_CACHE[key]
+    table = _pragmas(mod.source)
+    if key:
+        if len(_PRAGMA_CACHE) >= _PRAGMA_CACHE_MAX:
+            _PRAGMA_CACHE.clear()
+        _PRAGMA_CACHE[key] = table
+    return table
 
 
 def _pragmas(source: str) -> Dict[int, Set[str]]:
@@ -198,15 +217,12 @@ def run(paths: Sequence[str],
     kept: List[Finding] = []
     suppressed: List[Finding] = []
     baselined: List[Finding] = []
-    pragma_cache: Dict[str, Dict[int, Set[str]]] = {}
     for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
         mod = project.by_path.get(f.path)
         if mod is None:                      # pragma: no cover — defensive
             kept.append(f)
             continue
-        if f.path not in pragma_cache:      # setdefault would tokenize
-            pragma_cache[f.path] = _pragmas(mod.source)   # per finding
-        pragmas = pragma_cache[f.path]
+        pragmas = _pragmas_for(mod)     # content-hash cached
         if _is_suppressed(f, pragmas, mod.lines):
             suppressed.append(f)
         elif baseline and f.fingerprint in baseline:

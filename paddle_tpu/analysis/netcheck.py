@@ -1,0 +1,729 @@
+"""PT-SHAPE / PT-SHARD core: whole-model shape, dtype and sharding
+verification WITHOUT building a device program.
+
+The reference verified its proto-configured layer graph at config time
+— ``paddle/gserver`` layers were checked against ``ModelConfig`` before
+``paddle train`` ever touched a device — and this module restores that
+capability for the rebuild: an abstract interpreter that walks a
+``ModelConfig``'s layer graph propagating symbolic per-layer shapes and
+dtypes (no jax import, no tracing), a static re-derivation of the
+conv→BN fusion peepholes (:func:`fusion_plan` is the ONE implementation
+``layers/network.py`` builds from, so the static census can never drift
+from the runtime ``network_conv_bn_fused_pairs`` gauge), and a
+``ShardingRules``-table verifier that fails a bad rule in milliseconds
+instead of at pod-compile time.
+
+Everything here is **duck-typed** over the config IR: a "config" is
+anything with ``.layers`` / ``.sub_models`` / ``.output_layer_names`` /
+``.evaluators``, a "layer" anything with ``.name`` / ``.type`` /
+``.size`` / ``.inputs`` / ``.attrs``, an "input" anything with
+``.input_layer_name``.  The real
+:class:`paddle_tpu.config.model_config.ModelConfig` satisfies this, and
+so do the lightweight records the PT-SHAPE lint rule extracts from DSL
+call sites — which is what keeps this module (and the whole analysis
+package) stdlib-only and jax-free.
+
+Issue severities: ``"error"`` findings are contradictions that will
+fail at trace/compile time (the preflight raises on them);
+``"warn"`` findings are order/coverage surprises worth a look but
+legal (the lint rule only reports errors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: conv/BN layer-type families — mirrors layers/network.py's registry
+#: aliases (``register_layer`` names for the conv and batch_norm layers).
+CONV_TYPES = ("exconv", "cudnn_conv", "conv", "mkldnn_conv")
+BN_TYPES = ("batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm")
+
+
+@dataclasses.dataclass(frozen=True)
+class Issue:
+    """One verifier finding.  ``path`` is the layer-path provenance:
+    the producer chain that feeds the offending layer (innermost
+    last), prefixed with the sub-model name for group layers."""
+
+    kind: str                # "shape" | "dtype" | "shard"
+    severity: str            # "error" | "warn"
+    where: str               # layer name or rule/param identity
+    message: str
+    path: Tuple[str, ...] = ()
+
+    def render(self) -> str:
+        prov = " -> ".join(self.path)
+        loc = f"{self.where}" + (f" (via {prov})" if prov else "")
+        return f"[{self.kind}/{self.severity}] {loc}: {self.message}"
+
+
+# ===================================================== shape inference
+@dataclasses.dataclass
+class ValueInfo:
+    """Abstract value of one layer output: symbolic batch (and time for
+    sequences) with a concrete feature size when statically known."""
+
+    size: Optional[int] = None      # feature width; None = unknown
+    dtype: str = "float"            # "float" | "int" | "?" (unknown)
+    seq: bool = False               # carries a time dimension
+    channels: Optional[int] = None  # image geometry when known
+    img_x: Optional[int] = None
+    img_y: Optional[int] = None
+
+    def shape_str(self) -> str:
+        dims = ["B"]
+        if self.seq:
+            dims.append("T")
+        if self.channels and self.img_x:
+            dims += [str(self.img_x), str(self.img_y or self.img_x),
+                     str(self.channels)]
+        else:
+            dims.append(str(self.size) if self.size else "?")
+        return "[" + ", ".join(dims) + "]"
+
+
+def _conv_out(img: int, filt: int, pad: int, stride: int) -> int:
+    return (img + 2 * pad - filt) // stride + 1
+
+
+# cost-layer types whose (input, label) sizes must agree and whose
+# label must be an integer class id
+_CLASS_COSTS = ("multi-class-cross-entropy", "cross-entropy",
+                "cross-entropy-with-selfnorm")
+# regression costs: input and label are same-width dense floats
+_REG_COSTS = ("square_error", "smooth_l1", "huber_regression")
+# width-preserving elementwise layers: output size == input size
+_ELEMENTWISE = ("dropout", "clip", "scale_shift", "slope_intercept",
+                "batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm",
+                "norm", "layer_norm", "prelu")
+
+
+class _Graph:
+    """One (sub-)graph's layer records + the environment of inferred
+    values, shared with the parent graph for in-links/memories."""
+
+    def __init__(self, layers: Sequence[Any], env: Dict[str, ValueInfo],
+                 group: str = "", float_name: str = "float32"):
+        self.layers = list(layers)
+        self.env = env
+        self.group = group
+        self.float_name = float_name    # policy output dtype for floats
+        self.by_name = {l.name: l for l in self.layers}
+
+
+def _layer_path(graph: _Graph, name: str, depth: int = 4) -> Tuple[str, ...]:
+    """Producer chain feeding ``name`` (oldest first), for provenance."""
+    chain: List[str] = []
+    cur = name
+    seen: Set[str] = set()
+    while cur in graph.by_name and cur not in seen and len(chain) < depth:
+        seen.add(cur)
+        chain.append(cur)
+        ins = [i.input_layer_name for i in graph.by_name[cur].inputs]
+        if not ins:
+            break
+        cur = ins[0]
+    if cur not in seen and cur:
+        chain.append(cur)
+    prefix = (graph.group + "/") if graph.group else ""
+    return tuple(prefix + n for n in reversed(chain))
+
+
+def check_model(config: Any, policy: Optional[Tuple[str, str]] = None
+                ) -> List[Issue]:
+    """Verify a ModelConfig-like object; returns all issues found.
+
+    ``policy``: the resolved precision policy as ``(compute_dtype,
+    output_dtype)`` NAMES (``core/dtypes.py`` vocabulary —
+    ``NeuralNetwork.verify()`` passes the live one).  Float values
+    propagate as the policy *output* dtype, so a report under
+    ``--bf16_activations`` says ``bfloat16`` where it means it; the
+    mismatch lattice itself only distinguishes int / float-like / "?".
+    """
+    float_name = (policy or ("float32", "float32"))[1]
+    issues: List[Issue] = []
+    sub_layer_names: Set[str] = set()
+    for sm in getattr(config, "sub_models", []) or []:
+        if sm.name == "root":
+            continue
+        sub_layer_names.update(sm.layer_names)
+
+    root_layers = [l for l in config.layers
+                   if l.name not in sub_layer_names or l.type == "data"]
+    env: Dict[str, ValueInfo] = {}
+    # pre-seed declared sizes of group layers + memory links so group
+    # out-links and boot layers resolve when the root graph reads them
+    for l in config.layers:
+        if l.name in sub_layer_names:
+            env.setdefault(l.name, ValueInfo(size=l.size or None))
+    for sm in getattr(config, "sub_models", []) or []:
+        for mem in sm.memories:
+            link = mem.get("link_name") or mem.get("layer_name", "") + "@pre"
+            size = mem.get("size", 0)
+            if not size and mem.get("layer_name") in env:
+                size = env[mem["layer_name"]].size or 0
+            env[link] = ValueInfo(size=size or None, seq=False)
+
+    graph = _Graph(root_layers, env, float_name=float_name)
+    _check_graph(graph, issues)
+
+    # group bodies: same interpreter, sized in/out links pre-seeded
+    for sm in getattr(config, "sub_models", []) or []:
+        if sm.name == "root" or sm.is_generating:
+            continue
+        body = [l for l in config.layers if l.name in set(sm.layer_names)]
+        sub = _Graph(body, env, group=sm.name, float_name=float_name)
+        _check_graph(sub, issues)
+
+    issues.extend(_check_shared_params(config))
+    return issues
+
+
+def _value_of(graph: _Graph, name: str) -> Optional[ValueInfo]:
+    if name in graph.env:
+        return graph.env[name]
+    base = name.split(".", 1)[0]     # sub-output ("fc.logits")
+    return graph.env.get(base)
+
+
+def _err(issues: List[Issue], graph: _Graph, layer: Any,
+         msg: str, kind: str = "shape", severity: str = "error") -> None:
+    issues.append(Issue(kind, severity, layer.name, msg,
+                        _layer_path(graph, layer.name)))
+
+
+def _check_graph(graph: _Graph, issues: List[Issue]) -> None:
+    for layer in graph.layers:
+        lt = layer.type
+        name = layer.name
+        attrs = getattr(layer, "attrs", {}) or {}
+        ins: List[Optional[ValueInfo]] = []
+        for li in layer.inputs:
+            v = _value_of(graph, li.input_layer_name)
+            if v is None and lt != "data":
+                _err(issues, graph, layer,
+                     f"input {li.input_layer_name!r} has no producer "
+                     "in this graph")
+            ins.append(v)
+
+        out = ValueInfo()
+        if lt == "data":
+            kind = attrs.get("kind", "dense")
+            out = ValueInfo(size=layer.size or None,
+                            dtype="?" if kind == "?"
+                            else ("int" if kind == "index" else "float"),
+                            seq=bool(attrs.get("seq_level", 0)))
+        elif lt == "embedding":
+            if ins and ins[0] is not None \
+                    and ins[0].dtype not in ("int", "?"):
+                _err(issues, graph, layer,
+                     "embedding lookup over a non-integer input "
+                     f"(producer is {ins[0].dtype}, shape "
+                     f"{ins[0].shape_str()}) — ids must be an index "
+                     "input", kind="dtype")
+            out = ValueInfo(size=layer.size or None,
+                            seq=bool(ins and ins[0] and ins[0].seq))
+        elif lt in CONV_TYPES:
+            # NB: "exconvt" (transposed conv) deliberately falls to the
+            # opaque branch — its output geometry is the transpose
+            # formula, not _conv_out's, so no forward-conv check may
+            # judge it (no-false-positive discipline)
+            out = _check_conv(graph, layer, attrs, ins, issues)
+        elif lt == "pool":
+            out = _check_pool(graph, layer, attrs, ins, issues)
+        elif lt in _ELEMENTWISE:
+            src = ins[0] if ins else None
+            if src is not None and src.size and layer.size \
+                    and src.size != layer.size:
+                _err(issues, graph, layer,
+                     f"{lt} declares size {layer.size} but its input "
+                     f"is {src.shape_str()} — width-preserving layers "
+                     "cannot change the feature size")
+            out = dataclasses.replace(src) if src is not None \
+                else ValueInfo(size=layer.size or None)
+            if lt in BN_TYPES:
+                _check_bn_channels(graph, layer, attrs, src, issues)
+        elif lt == "addto":
+            sizes = {v.size for v in ins if v is not None and v.size}
+            if len(sizes) > 1:
+                _err(issues, graph, layer,
+                     "addto inputs disagree on width: "
+                     + ", ".join(f"{li.input_layer_name}="
+                                 f"{v.size if v else '?'}"
+                                 for li, v in zip(layer.inputs, ins)))
+            src = next((v for v in ins if v is not None), None)
+            out = dataclasses.replace(src) if src is not None \
+                else ValueInfo(size=layer.size or None)
+        elif lt == "concat":
+            known = [v.size for v in ins if v is not None]
+            if all(known) and known and layer.size \
+                    and sum(known) != layer.size:
+                _err(issues, graph, layer,
+                     f"concat declares size {layer.size} but its "
+                     f"inputs sum to {sum(known)}")
+            out = ValueInfo(size=layer.size or None,
+                            seq=bool(ins and ins[0] and ins[0].seq))
+        elif lt == "cos_sim":
+            if len(ins) == 2 and all(v is not None and v.size
+                                     for v in ins) \
+                    and ins[0].size != ins[1].size \
+                    and 1 not in (ins[0].size, ins[1].size):
+                _err(issues, graph, layer,
+                     f"cos_sim inputs have different widths "
+                     f"{ins[0].size} vs {ins[1].size}")
+            out = ValueInfo(size=1)
+        elif lt in _CLASS_COSTS:
+            _check_class_cost(graph, layer, ins, issues)
+            out = ValueInfo(size=1)
+        elif lt in _REG_COSTS:
+            if len(ins) >= 2 and all(v is not None and v.size
+                                     for v in ins[:2]) \
+                    and ins[0].size != ins[1].size:
+                _err(issues, graph, layer,
+                     f"{lt} input width {ins[0].size} != label width "
+                     f"{ins[1].size}")
+            if len(ins) >= 2 and ins[1] is not None \
+                    and ins[1].dtype == "int":
+                _err(issues, graph, layer,
+                     f"{lt} regresses against an integer label — use "
+                     "a dense target (or a classification cost)",
+                     kind="dtype")
+            out = ValueInfo(size=1)
+        elif lt in ("seqlastins", "seqfirstins", "max_id"):
+            src = ins[0] if ins else None
+            out = ValueInfo(size=(src.size if src else None)
+                            if lt != "max_id" else 1,
+                            dtype="int" if lt == "max_id"
+                            else (src.dtype if src else "float"))
+        else:
+            # unknown/opaque layer type: trust the declared size, keep
+            # sequence-ness of the first input (under-approximation —
+            # no checks, no false positives)
+            out = ValueInfo(size=layer.size or None,
+                            seq=bool(ins and ins[0] and ins[0].seq),
+                            dtype=(ins[0].dtype if ins and ins[0]
+                                   else "float"))
+        # fc consumes any input width (per-timestep over sequences)
+        if lt == "fc":
+            out = ValueInfo(size=layer.size or None,
+                            seq=bool(ins and ins[0] and ins[0].seq))
+        # float values carry the policy-resolved output dtype name, so
+        # reports under --bf16_activations say bfloat16 where they
+        # mean it (the mismatch lattice is int / float-like / "?")
+        if out.dtype == "float":
+            out = dataclasses.replace(out, dtype=graph.float_name)
+        graph.env[name] = out
+
+
+def _check_conv(graph: _Graph, layer: Any, attrs: Dict[str, Any],
+                ins: List[Optional[ValueInfo]],
+                issues: List[Issue]) -> ValueInfo:
+    c = attrs.get("channels")
+    img = attrs.get("img_size")
+    img_y = attrs.get("img_size_y", img)
+    nf = attrs.get("num_filters")
+    fs = attrs.get("filter_size")
+    stride = attrs.get("stride", 1)
+    pad = attrs.get("padding", 0)
+    groups = attrs.get("groups", 1)
+    src = ins[0] if ins else None
+    if c and img and img_y and src is not None and src.size \
+            and c * img * img_y != src.size:
+        _err(issues, graph, layer,
+             f"conv expects input {c}ch × {img}×{img_y} = "
+             f"{c * img * img_y} values but its producer supplies "
+             f"{src.shape_str()} — wrong num_channels/img_size for "
+             "this input")
+    if groups and c and c % groups:
+        _err(issues, graph, layer,
+             f"conv groups={groups} does not divide input "
+             f"channels={c}")
+    if groups and nf and nf % groups:
+        _err(issues, graph, layer,
+             f"conv groups={groups} does not divide "
+             f"num_filters={nf}")
+    out_x = attrs.get("output_x")
+    out_y = attrs.get("output_y")
+    if img and fs is not None and out_x is None:
+        out_x = _conv_out(img, fs, pad, stride)
+        out_y = _conv_out(img_y, fs, pad, stride)
+    if out_x is not None and out_x <= 0:
+        _err(issues, graph, layer,
+             f"conv geometry collapses: image {img}×{img_y} with "
+             f"filter {fs}, stride {stride}, padding {pad} yields a "
+             f"{out_x}-wide output")
+    if nf and out_x and out_y and layer.size \
+            and nf * out_x * out_y != layer.size:
+        _err(issues, graph, layer,
+             f"conv declares size {layer.size} but computes "
+             f"{nf}×{out_x}×{out_y} = {nf * out_x * out_y}")
+    return ValueInfo(size=layer.size or (nf * out_x * out_y
+                                         if nf and out_x and out_y
+                                         else None),
+                     channels=nf, img_x=out_x, img_y=out_y)
+
+
+def _check_pool(graph: _Graph, layer: Any, attrs: Dict[str, Any],
+                ins: List[Optional[ValueInfo]],
+                issues: List[Issue]) -> ValueInfo:
+    c = attrs.get("channels")
+    img = attrs.get("img_size")
+    img_y = attrs.get("img_size_y", img)
+    ps = attrs.get("pool_size")
+    stride = attrs.get("stride", 2)
+    pad = attrs.get("padding", 0)
+    src = ins[0] if ins else None
+    if c and img and img_y and src is not None and src.size \
+            and c * img * img_y != src.size:
+        _err(issues, graph, layer,
+             f"pool expects input {c}ch × {img}×{img_y} = "
+             f"{c * img * img_y} values but its producer supplies "
+             f"{src.shape_str()}")
+    out_x = out_y = None
+    if img and ps is not None:
+        out_x = _conv_out(img, ps, pad, stride)
+        out_y = _conv_out(img_y, ps, pad, stride)
+        if out_x <= 0:
+            _err(issues, graph, layer,
+                 f"pool geometry collapses: image {img}×{img_y} with "
+                 f"window {ps}, stride {stride}, padding {pad}")
+        elif c and layer.size and c * out_x * out_y != layer.size:
+            _err(issues, graph, layer,
+                 f"pool declares size {layer.size} but computes "
+                 f"{c}×{out_x}×{out_y} = {c * out_x * out_y}")
+    return ValueInfo(size=layer.size or None, channels=c,
+                     img_x=out_x, img_y=out_y)
+
+
+def _check_bn_channels(graph: _Graph, layer: Any, attrs: Dict[str, Any],
+                       src: Optional[ValueInfo],
+                       issues: List[Issue]) -> None:
+    c = attrs.get("channels")
+    img = attrs.get("img_size")
+    img_y = attrs.get("img_size_y", img)
+    size = layer.size or (src.size if src else None)
+    if c and img and img_y and size and c * img * img_y != size:
+        _err(issues, graph, layer,
+             f"batch_norm normalizes {c} channels over a {img}×{img_y}"
+             f" image = {c * img * img_y} values, but the layer is "
+             f"{size} wide — wrong num_channels for this input")
+    elif c and not img and size and c != size:
+        _err(issues, graph, layer,
+             f"batch_norm (no image geometry) normalizes {c} channels "
+             f"but the layer is {size} wide")
+
+
+def _check_class_cost(graph: _Graph, layer: Any,
+                      ins: List[Optional[ValueInfo]],
+                      issues: List[Issue]) -> None:
+    if len(ins) < 2:
+        return
+    pred, label = ins[0], ins[1]
+    if pred is not None and label is not None \
+            and pred.size and label.size and pred.size != label.size:
+        _err(issues, graph, layer,
+             f"classification cost reads {pred.size} class "
+             f"probabilities but the label layer declares "
+             f"{label.size} classes")
+    if label is not None and label.dtype not in ("int", "?"):
+        _err(issues, graph, layer,
+             "classification cost needs an integer class-id label, "
+             f"got a {label.dtype} input {label.shape_str()}",
+             kind="dtype")
+
+
+def _check_shared_params(config: Any) -> List[Issue]:
+    """Statically derivable parameter shapes must agree across sharing
+    layers (the static twin of NeuralNetwork._collect_specs enforce)."""
+    issues: List[Issue] = []
+    lmap = {l.name: l for l in config.layers}
+    seen: Dict[str, Tuple[str, Tuple[int, ...]]] = {}
+    for layer in config.layers:
+        if layer.type != "fc":
+            continue
+        for li in layer.inputs:
+            pname = getattr(li, "input_parameter_name", "")
+            if not pname:
+                continue
+            src = lmap.get(li.input_layer_name)
+            if src is None or not src.size or not layer.size:
+                continue
+            dims = (src.size, layer.size)
+            prev = seen.get(pname)
+            if prev is not None and prev[1] != dims:
+                issues.append(Issue(
+                    "shape", "error", layer.name,
+                    f"shared parameter {pname!r} is [{dims[0]}, "
+                    f"{dims[1]}] here but [{prev[1][0]}, {prev[1][1]}] "
+                    f"in layer {prev[0]!r}",
+                    (li.input_layer_name, layer.name)))
+            else:
+                seen.setdefault(pname, (layer.name, dims))
+    return issues
+
+
+# ==================================================== conv→BN fusion plan
+def _root_and_outputs(config: Any) -> Tuple[Set[str], List[str]]:
+    sub_layer_names: Set[str] = set()
+    for sm in getattr(config, "sub_models", []) or []:
+        if sm.name != "root":
+            sub_layer_names.update(sm.layer_names)
+    order = [l.name for l in config.layers
+             if l.name not in sub_layer_names or l.type == "data"]
+    outputs = list(getattr(config, "output_layer_names", []) or []) \
+        or (order[-1:] if order else [])
+    return set(order), outputs
+
+
+def fusion_plan(config: Any, root_layers: Optional[Set[str]] = None,
+                output_names: Optional[Sequence[str]] = None,
+                fuse_bwd: bool = True, fuse_fwd: bool = True
+                ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """The build-time conv/BN fusion resolution, as a pure function of
+    the config: returns ``(bwd, fwd)`` where ``bwd`` maps a batch-norm
+    to the 3×3 conv it back-fuses (``conv2d_bn``) and ``fwd`` maps a
+    consuming conv to the batch-norm whose apply pass defers into it
+    (``affine_act_conv2d``).  :class:`~paddle_tpu.layers.network.
+    NeuralNetwork` builds its peephole tables by calling THIS function,
+    so a static census computed here is the runtime census by
+    construction.
+    """
+    lmap = {l.name: l for l in config.layers}
+    if root_layers is None or output_names is None:
+        derived_root, derived_out = _root_and_outputs(config)
+        root_layers = root_layers if root_layers is not None \
+            else derived_root
+        output_names = output_names if output_names is not None \
+            else derived_out
+
+    n_consumers: Dict[str, int] = {}
+    for lc in config.layers:
+        for iname in (i.input_layer_name for i in lc.inputs):
+            n_consumers[iname] = n_consumers.get(iname, 0) + 1
+    # consumers that read values by name OUTSIDE layer input lists:
+    # group in/out links, memory boot layers, generator static inputs,
+    # and evaluator inputs — a conv referenced by any of these must
+    # keep its standalone value
+    extra: Set[str] = set()
+    for sm in getattr(config, "sub_models", []) or []:
+        if sm.name == "root":
+            continue
+        extra.update(sm.in_links)
+        extra.update(sm.out_links)
+        for m in sm.memories:
+            if m.get("boot_layer_name"):
+                extra.add(m["boot_layer_name"])
+        extra.update(sm.generator.get("static_inputs", ()))
+    for ev in getattr(config, "evaluators", []) or []:
+        for key in ("input_layer_name", "label_layer_name"):
+            if ev.get(key):
+                extra.add(ev[key])
+    outputs = set(output_names) | extra
+
+    bwd: Dict[str, str] = {}
+    if fuse_bwd:
+        for lconf in config.layers:
+            if lconf.type not in BN_TYPES or len(lconf.inputs) != 1 \
+                    or lconf.name not in root_layers:
+                continue
+            pname = lconf.inputs[0].input_layer_name
+            pconf = lmap.get(pname)
+            if pconf is None or pconf.type not in CONV_TYPES \
+                    or pname not in root_layers:
+                continue
+            a = pconf.attrs
+            f = a.get("filter_size")
+            s = a.get("stride", 1)
+            p = a.get("padding", 0)
+            if (f == 3 and a.get("filter_size_y", f) == 3
+                    and s == 1 and a.get("stride_y", s) == 1
+                    and p == 1 and a.get("padding_y", p) == 1
+                    and a.get("groups", 1) == 1
+                    and len(pconf.inputs) == 1
+                    and pconf.active_type in ("", "linear")
+                    and pconf.drop_rate == 0
+                    and pconf.error_clipping_threshold == 0
+                    and n_consumers.get(pname, 0) == 1
+                    and pname not in outputs):
+                bwd[lconf.name] = pname
+
+    fwd: Dict[str, str] = {}
+    if fuse_fwd:
+        for lconf in config.layers:        # lconf = the consuming conv
+            if lconf.type not in CONV_TYPES \
+                    or len(lconf.inputs) != 1 \
+                    or lconf.name not in root_layers:
+                continue
+            a = lconf.attrs
+            f = a.get("filter_size")
+            fy = a.get("filter_size_y", f)
+            s = a.get("stride", 1)
+            sy = a.get("stride_y", s)
+            p = a.get("padding", 0)
+            py = a.get("padding_y", p)
+            geom3 = (f == 3 and fy == 3 and s == 1 and sy == 1
+                     and p == 1 and py == 1)
+            geom1 = (f == 1 and fy == 1 and s == 1 and sy == 1
+                     and p == 0 and py == 0)
+            if not (geom3 or geom1) or a.get("groups", 1) != 1:
+                continue
+            pname = lconf.inputs[0].input_layer_name
+            pconf = lmap.get(pname)
+            if pconf is None or pconf.type not in BN_TYPES \
+                    or pname not in root_layers:
+                continue
+            if (pconf.active_type not in ("", "linear", "relu")
+                    or pconf.drop_rate != 0
+                    or pconf.error_clipping_threshold != 0
+                    or len(pconf.inputs) != 1
+                    or pconf.attrs.get("img_size") is None):
+                continue
+            if n_consumers.get(pname, 0) != 1 or pname in outputs:
+                continue
+            fwd[lconf.name] = pname
+        # a deferred BN publishes (z, a, c) instead of its applied
+        # output, so it can no longer be the OUTPUT of a backward-fused
+        # pair — its upstream conv reverts to a standalone value.  (A
+        # bwd entry whose CONV is a fwd consumer stays: that pair runs
+        # as the chain op with the deferred affine as its prologue.)
+        for bn in fwd.values():
+            bwd.pop(bn, None)
+    return bwd, fwd
+
+
+def fused_pair_census(config: Any, fuse_bwd: bool = True,
+                      fuse_fwd: bool = True) -> Dict[str, int]:
+    """Static census keyed exactly like the runtime
+    ``network_conv_bn_fused_pairs{direction,kernel}`` gauge."""
+    bwd, fwd = fusion_plan(config, fuse_bwd=fuse_bwd, fuse_fwd=fuse_fwd)
+    lmap = {l.name: l for l in config.layers}
+    fwd3 = sum(1 for cv in fwd
+               if lmap[cv].attrs.get("filter_size") == 3)
+    return {"bwd_3x3": len(bwd), "fwd_3x3": fwd3,
+            "fwd_1x1": len(fwd) - fwd3}
+
+
+# ======================================================= sharding verify
+def _spec_axes(spec: Any) -> List[List[str]]:
+    """PartitionSpec-like → per-dim list of mesh-axis names (a dim may
+    carry one axis, a tuple of axes, or None = replicated)."""
+    dims: List[List[str]] = []
+    for entry in tuple(spec):
+        if entry is None:
+            dims.append([])
+        elif isinstance(entry, (tuple, list)):
+            dims.append([str(a) for a in entry])
+        else:
+            dims.append([str(entry)])
+    return dims
+
+
+def check_sharding(rules: Any, param_dims: Dict[str, Sequence[int]],
+                   mesh_axes: Dict[str, int],
+                   strict: bool = False) -> List[Issue]:
+    """Verify a ShardingRules table against a model's parameter tree.
+
+    ``rules``: a ``ShardingRules`` (duck-typed: ``.rules`` list of
+    ``(compiled_pattern, PartitionSpec)``) or the list itself.
+    ``param_dims``: parameter name → dims.  ``mesh_axes``: axis name →
+    size for ONE topology; call once per topology.
+
+    Errors (preflight-fatal): a resolved spec names an unknown mesh
+    axis, or a sharded dim is not divisible by the product of its mesh
+    axes; in ``strict`` mode an unmatched parameter too.  Warnings:
+    unmatched parameters (silently replicated — the table has no
+    opinion), rules that match nothing in this model, higher-priority
+    matches excluded by rank, and multi-matches that first-match-wins
+    resolves (ambiguity worth an explicit pattern).
+    """
+    table = list(getattr(rules, "rules", rules))
+    issues: List[Issue] = []
+    matched_any = [False] * len(table)
+
+    for pname in sorted(param_dims):
+        dims = [int(d) for d in param_dims[pname]]
+        ndim = len(dims)
+        matching = [(i, pat, spec) for i, (pat, spec) in enumerate(table)
+                    if pat.search(pname)]
+        applicable = [(i, pat, spec) for i, pat, spec in matching
+                      if len(tuple(spec)) <= ndim]
+        for i, _, _ in matching:
+            matched_any[i] = True
+        if not matching:
+            issues.append(Issue(
+                "shard", "error" if strict else "warn", pname,
+                f"parameter matches NO sharding rule — silently "
+                f"replicated over the {dict(mesh_axes)} mesh"))
+            continue
+        if not applicable:
+            issues.append(Issue(
+                "shard", "error", pname,
+                f"every matching rule's spec rank exceeds the "
+                f"parameter rank {ndim} (dims {dims}) — the table "
+                "cannot place this parameter (rank-excluded rules: "
+                + ", ".join(f"#{i} {pat.pattern!r}"
+                            for i, pat, _ in matching) + ")"))
+            continue
+        first_i, first_pat, spec = applicable[0]
+        if matching[0][0] != first_i:
+            i, pat, s = matching[0]
+            issues.append(Issue(
+                "shard", "warn", pname,
+                f"highest-priority match #{i} {pat.pattern!r} is "
+                f"rank-excluded (spec rank {len(tuple(s))} > param "
+                f"rank {ndim}); rule #{first_i} {first_pat.pattern!r} "
+                "applies instead — tighten the pattern if unintended"))
+        distinct = {tuple(s) for _, _, s in applicable}
+        if len(distinct) > 1:
+            issues.append(Issue(
+                "shard", "warn", pname,
+                "ambiguous coverage: rules "
+                + ", ".join(f"#{i} {p.pattern!r}→{tuple(s)}"
+                            for i, p, s in applicable)
+                + f" all match; first-match-wins resolves to "
+                  f"#{first_i} {first_pat.pattern!r}"))
+        # divisibility + axis existence of the RESOLVED spec
+        for d, axes in enumerate(_spec_axes(spec)):
+            shard = 1
+            for ax in axes:
+                if ax not in mesh_axes:
+                    issues.append(Issue(
+                        "shard", "error", pname,
+                        f"rule #{first_i} {first_pat.pattern!r} "
+                        f"shards dim {d} over mesh axis {ax!r} which "
+                        f"does not exist in {dict(mesh_axes)}"))
+                    shard = 0
+                    break
+                shard *= int(mesh_axes[ax])
+            if shard > 1 and dims[d] % shard:
+                issues.append(Issue(
+                    "shard", "error", pname,
+                    f"dim {d} of size {dims[d]} is not divisible by "
+                    f"the {'×'.join(axes)} mesh extent {shard} "
+                    f"(rule #{first_i} {first_pat.pattern!r}, dims "
+                    f"{dims}) — this table cannot compile on "
+                    f"{dict(mesh_axes)}"))
+    for i, hit in enumerate(matched_any):
+        if not hit and param_dims:
+            pat, spec = table[i]
+            issues.append(Issue(
+                "shard", "warn", f"rule #{i}",
+                f"pattern {pat.pattern!r} matches no parameter of "
+                "this model — dead rule (or a typo shadowing a real "
+                "one)"))
+    return issues
+
+
+def errors(issues: Iterable[Issue]) -> List[Issue]:
+    return [i for i in issues if i.severity == "error"]
+
+
+def render_report(issues: Sequence[Issue]) -> str:
+    if not issues:
+        return "netcheck: clean"
+    lines = [i.render() for i in issues]
+    n_err = len(errors(issues))
+    lines.append(f"netcheck: {n_err} error(s), "
+                 f"{len(issues) - n_err} warning(s)")
+    return "\n".join(lines)

@@ -1,0 +1,567 @@
+"""PT-RACE — cross-thread shared-state race detection.
+
+The framework now runs a small fleet of threads inside one process —
+pipeline/reader workers, the trace writer, the metrics reporter, the
+observability HTTP server, the fleet aggregator, the master read-ahead
+fetcher, SIGUSR2/SIGTERM helpers — every one named with the ``ptpu-``
+prefix the conftest leak guard audits.  This rule derives, from the
+one-parse callgraph, the set of instance attributes and module globals
+**reachable from two distinct ``ptpu-*`` thread entrypoints** (or from
+one entrypoint started as a pool) **with at least one write and no
+common ``named_lock`` guard on all access paths** — the static shape of
+a data race.
+
+Model (under-approximate, like every rule in this package — a finding
+is near-certain):
+
+- **entrypoints** are the statically-resolved ``target=`` of
+  ``threading.Thread(...)`` constructions whose ``name=`` constant-
+  propagates to a ``ptpu-`` prefix (the PT-RESOURCE machinery).  A
+  construction inside a loop/comprehension is a *pool*: the entrypoint
+  is concurrent with itself.
+- **reachability** follows the conservative call resolution of
+  :mod:`~paddle_tpu.analysis.callgraph`, carrying the set of lock
+  identities (:mod:`~paddle_tpu.analysis.lockorder` names, shared with
+  PT-LOCK) that are *always held* on every discovered path — the
+  intersection over call sites, shrunk to fixpoint.
+- **shared state**: ``self.attr`` loads/stores grouped per
+  ``(module, class, attr)``, and module globals written through a
+  ``global`` declaration (or mutated via a method call on the global).
+  Attributes/globals bound to thread-safe primitives (locks,
+  conditions, events, semaphores, queues, ``threading.local``) are
+  exempt — their methods are their guard.  ``__init__`` is never
+  thread-entrypoint-reachable, so construction-time writes are
+  happens-before and invisible here.
+- a **finding** needs: accesses from ≥ 2 distinct entrypoints (a pool
+  counts twice), ≥ 1 write among them, and an empty intersection of
+  the guard sets over all access sites.  It is reported once per
+  shared variable, anchored at the first unguarded write, with the
+  witnessing entrypoints and sites in the message.
+
+Deliberate benign races (e.g. a joined writer thread's teardown field)
+carry ``# ptpu: lint-ok[PT-RACE]`` pragmas with a justification, same
+as every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import FunctionInfo, ModuleInfo, Project, dotted_name
+from .engine import Finding
+
+
+def _lock_helpers():
+    """PT-LOCK's lock-identity machinery (shared so PT-RACE guards and
+    the lock graph name the same nodes).  Imported lazily: the rules
+    package imports this module, so a top-level import would be
+    circular when racecheck is imported first."""
+    from .rules.lock_order import _collect_locks, _with_lock_ids
+
+    return _collect_locks, _with_lock_ids
+
+
+def _name_helpers():
+    from .rules.resource import (THREAD_PREFIX, _imported_constant,
+                                 _static_name_prefix)
+
+    return THREAD_PREFIX, _imported_constant, _static_name_prefix
+
+
+RULE = "PT-RACE"
+
+#: Constructors whose objects are internally synchronized — an
+#: attribute/global bound to one of these is not shared *state*, it is
+#: the synchronization itself.
+_THREADSAFE_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "local", "named_lock", "named_condition",
+}
+
+#: Method calls that mutate their receiver (container mutation counts
+#: as a write to the shared variable holding the container).
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popitem", "popleft", "remove",
+    "discard", "clear", "sort", "reverse",
+}
+
+Site = Tuple[str, int]              # (abs path, line)
+AttrKey = Tuple[str, str, str]      # ("attr", mod.Class, attr) — rendered
+                                    # ("global", mod, name) for globals
+
+
+class _Entry:
+    __slots__ = ("fn", "thread_name", "pooled", "site")
+
+    def __init__(self, fn: FunctionInfo, thread_name: str, pooled: bool,
+                 site: Site):
+        self.fn = fn
+        self.thread_name = thread_name
+        self.pooled = pooled
+        self.site = site
+
+    def label(self) -> str:
+        return (f"{self.fn.module.short()}.{self.fn.qualname} "
+                f"[{self.thread_name}{'*' if self.pooled else ''}]")
+
+
+class _Access:
+    __slots__ = ("key", "kind", "guards", "site", "fn")
+
+    def __init__(self, key: AttrKey, kind: str,
+                 guards: FrozenSet[str], site: Site, fn: FunctionInfo):
+        self.key = key
+        self.kind = kind            # "read" | "write"
+        self.guards = guards
+        self.site = site
+        self.fn = fn
+
+
+# ----------------------------------------------------------- entrypoints
+def _resolve_ref(project: Project, mod: ModuleInfo,
+                 fn: Optional[FunctionInfo],
+                 node: ast.AST) -> Optional[FunctionInfo]:
+    """Resolve a function *reference* expression (not a call) — the
+    ``target=`` of a Thread construction."""
+    if isinstance(node, ast.Name):
+        return project.resolve_name(mod, fn, node.id)
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        base, attr = node.value.id, node.attr
+        if base == "self" and fn is not None and fn.class_name:
+            return mod.functions.get(fn.class_name + "." + attr)
+        if base in mod.imports:
+            return project._function_in(mod.imports[base], attr)
+        tgt = mod.from_imports.get(base)
+        if tgt is not None:
+            dotted = (tgt[0] + "." + tgt[1]) if tgt[0] else tgt[1]
+            return project._function_in(dotted, attr)
+        cls = mod.instance_of.get(base)
+        if cls is None and fn is not None:
+            cls = _local_instance_class(mod, fn, base)
+        if cls is not None and "." not in cls:
+            return mod.functions.get(cls + "." + attr)
+    return None
+
+
+def _local_instance_class(mod: ModuleInfo, fn: FunctionInfo,
+                          var: str) -> Optional[str]:
+    """``c = ClassName(...)`` directly in ``fn`` → "ClassName" when the
+    class is defined in this module (the module-level ``instance_of``
+    table, scoped to a function body)."""
+    hit: Optional[str] = None
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == var \
+                and isinstance(node.value, ast.Call):
+            cls = dotted_name(node.value.func)
+            if cls is not None and cls in mod.classes:
+                hit = cls
+            else:
+                return None         # rebound to something else: give up
+    return hit
+
+
+def _is_thread_ctor(project: Project, mod: ModuleInfo,
+                    call: ast.Call) -> bool:
+    chain = dotted_name(call.func)
+    if chain is None or chain.split(".")[-1] != "Thread":
+        return False
+    root = chain.split(".")[0]
+    if root == "Thread":
+        return mod.from_imports.get("Thread", ("", ""))[0] == "threading"
+    return project.names_module(mod, root, "threading")
+
+
+def _enclosing_fn(mod: ModuleInfo, node: ast.AST) -> Optional[FunctionInfo]:
+    best: Optional[FunctionInfo] = None
+    for f in mod.functions.values():
+        for n in ast.walk(f.node):
+            if n is node:
+                if best is None or len(f.qualname) > len(best.qualname):
+                    best = f
+                break
+    return best
+
+
+def _is_pooled(owner_node: ast.AST, call: ast.Call) -> bool:
+    """Thread construction inside a loop or comprehension — N instances
+    of the same entrypoint run concurrently with each other."""
+    loops = (ast.For, ast.While, ast.AsyncFor, ast.ListComp, ast.SetComp,
+             ast.DictComp, ast.GeneratorExp)
+    for n in ast.walk(owner_node):
+        if isinstance(n, loops):
+            for inner in ast.walk(n):
+                if inner is call:
+                    return True
+    return False
+
+
+def find_entrypoints(project: Project) -> List[_Entry]:
+    THREAD_PREFIX, _imported_constant, _static_name_prefix = \
+        _name_helpers()
+    out: List[_Entry] = []
+    seen: Set[Tuple[FunctionInfo, str]] = set()
+    for mod in project.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not _is_thread_ctor(project, mod, node):
+                continue
+            name_kw = next((kw.value for kw in node.keywords
+                            if kw.arg == "name"), None)
+            if name_kw is None:
+                continue
+            prefix = _static_name_prefix(mod, name_kw)
+            if prefix is None and isinstance(name_kw, ast.Name):
+                prefix = _imported_constant(project, mod, name_kw.id)
+            if prefix is None and isinstance(name_kw, ast.BinOp) \
+                    and isinstance(name_kw.op, ast.Add) \
+                    and isinstance(name_kw.left, ast.Name):
+                prefix = _imported_constant(project, mod, name_kw.left.id)
+            if prefix is None and isinstance(name_kw, ast.JoinedStr) \
+                    and name_kw.values \
+                    and isinstance(name_kw.values[0], ast.FormattedValue) \
+                    and isinstance(name_kw.values[0].value, ast.Name):
+                prefix = _imported_constant(
+                    project, mod, name_kw.values[0].value.id)
+            if prefix is None or not prefix.startswith(THREAD_PREFIX):
+                continue
+            tgt_node = next((kw.value for kw in node.keywords
+                             if kw.arg == "target"), None)
+            if tgt_node is None and len(node.args) >= 2:
+                tgt_node = node.args[1]
+            if tgt_node is None:
+                continue
+            owner = _enclosing_fn(mod, node)
+            fn = _resolve_ref(project, mod, owner, tgt_node)
+            if fn is None:
+                continue
+            pooled = owner is not None and _is_pooled(owner.node, node)
+            key = (fn, prefix)
+            if key in seen:
+                # a second *distinct* construction site of the same
+                # target makes it pool-like too
+                for e in out:
+                    if e.fn is fn and e.thread_name == prefix \
+                            and e.site != (mod.path, node.lineno):
+                        e.pooled = True
+                continue
+            seen.add(key)
+            out.append(_Entry(fn, prefix, pooled,
+                              (mod.path, node.lineno)))
+    out.extend(_http_handler_entrypoints(project, seen))
+    return out
+
+
+_SERVER_CTORS = {"ThreadingHTTPServer", "make_threading_server"}
+_HANDLER_METHODS = ("do_GET", "do_POST", "do_PUT", "do_DELETE",
+                    "do_HEAD")
+
+
+def _http_handler_entrypoints(project: Project,
+                              seen: Set[Tuple[FunctionInfo, str]]
+                              ) -> List[_Entry]:
+    """A request-handler class handed to a threading HTTP server runs
+    its ``do_*`` methods on per-request threads — each is a *pooled*
+    entrypoint (two requests race each other), even though no explicit
+    ``threading.Thread`` construction names them."""
+    out: List[_Entry] = []
+    for mod in project.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            if chain is None \
+                    or chain.split(".")[-1] not in _SERVER_CTORS:
+                continue
+            for arg in node.args:
+                if not isinstance(arg, ast.Name) \
+                        or arg.id not in mod.classes:
+                    continue
+                for meth in _HANDLER_METHODS:
+                    fn = mod.functions.get(f"{arg.id}.{meth}")
+                    if fn is None:
+                        continue
+                    key = (fn, f"http:{arg.id}")
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(_Entry(fn, f"http:{arg.id}", True,
+                                      (mod.path, node.lineno)))
+    return out
+
+
+# --------------------------------------------------- per-function summary
+class _FnSummary:
+    __slots__ = ("calls", "accesses")
+
+    def __init__(self) -> None:
+        # (callee, lexical-held-at-site)
+        self.calls: List[Tuple[FunctionInfo, FrozenSet[str]]] = []
+        # (key, kind, lexical-held, site)
+        self.accesses: List[Tuple[AttrKey, str, FrozenSet[str], Site]] = []
+
+
+def _threadsafe_members(project: Project) -> Tuple[Set[AttrKey],
+                                                   Set[AttrKey]]:
+    """(exempt attr keys, exempt global keys): members bound to
+    internally-synchronized objects anywhere in the project."""
+    attrs: Set[AttrKey] = set()
+    globs: Set[AttrKey] = set()
+
+    def ctor_leaf(value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = dotted_name(value.func)
+        return chain.split(".")[-1] if chain else None
+
+    for mod in project.iter_modules():
+        for fn in mod.functions.values():
+            if fn.class_name is None:
+                continue
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                leaf = ctor_leaf(node.value)
+                if leaf not in _THREADSAFE_CTORS:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        attrs.add(("attr",
+                                   f"{mod.name}.{fn.class_name}", t.attr))
+        for node in ast.iter_child_nodes(mod.tree):
+            if isinstance(node, ast.Assign):
+                leaf = ctor_leaf(node.value)
+                if leaf in _THREADSAFE_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            globs.add(("global", mod.name, t.id))
+    return attrs, globs
+
+
+def _module_globals(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.iter_child_nodes(mod.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _summarize(project: Project, locks, fn: FunctionInfo,
+               mod_globals: Set[str]) -> _FnSummary:
+    _, _with_lock_ids = _lock_helpers()
+    mod = fn.module
+    s = _FnSummary()
+    declared_global: Set[str] = set()
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Global):
+            declared_global.update(n.names)
+
+    cls_key = f"{mod.name}.{fn.class_name}" if fn.class_name else None
+
+    def is_self_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and cls_key is not None:
+            return node.attr
+        return None
+
+    def global_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name) and node.id in mod_globals \
+                and node.id not in fn.params \
+                and (node.id in declared_global
+                     or node.id not in fn.locals):
+            return node.id
+        return None
+
+    def access(node: ast.AST, kind: str, held: FrozenSet[str]) -> None:
+        site = (mod.path, node.lineno)
+        attr = is_self_attr(node)
+        if attr is not None:
+            s.accesses.append((("attr", cls_key, attr), kind, held, site))
+            return
+        g = global_name(node)
+        if g is not None:
+            s.accesses.append((("global", mod.name, g), kind, held, site))
+
+    def walk(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                      # separate function
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                walk(item.context_expr, new_held)
+                lid = _with_lock_ids(project, locks, mod, fn, item)
+                if lid is not None:
+                    new_held = new_held | {lid}
+            for child in node.body:
+                walk(child, new_held)
+            return
+        if isinstance(node, ast.Assign):
+            walk(node.value, held)
+            for t in node.targets:
+                _walk_target(t, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            walk(node.value, held)
+            _walk_target(node.target, held, aug=True)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                _walk_target(t, held)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            # mutating method call on a shared container
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+                access(f.value, "write", held)
+            tgt = project.resolve_call(mod, fn, node)
+            if tgt is not None:
+                s.calls.append((tgt, held))
+            # by-reference function args stay on this thread's stack
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    ref = project.resolve_name(mod, fn, a.id)
+                    if ref is not None:
+                        s.calls.append((ref, held))
+        if isinstance(node, (ast.Attribute, ast.Name)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            access(node, "read", held)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    def _walk_target(t: ast.AST, held: FrozenSet[str],
+                     aug: bool = False) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _walk_target(e, held, aug)
+            return
+        if isinstance(t, ast.Attribute):
+            access(t, "write", held)
+            walk(t.value, held)
+            return
+        if isinstance(t, ast.Subscript):
+            # container[k] = v mutates the container the name holds
+            access(t.value, "write", held)
+            walk(t.value, held)
+            walk(t.slice, held)
+            return
+        if isinstance(t, ast.Name):
+            if aug or t.id in declared_global:
+                access(t, "write", held)
+
+    for child in ast.iter_child_nodes(fn.node):
+        walk(child, frozenset())
+    return s
+
+
+# ------------------------------------------------------------ the engine
+def analyze(project: Project) -> List[Finding]:
+    _collect_locks, _ = _lock_helpers()
+    locks = _collect_locks(project)
+    entries = find_entrypoints(project)
+    if not entries:
+        return []
+    exempt_attrs, exempt_globals = _threadsafe_members(project)
+    mod_globals: Dict[str, Set[str]] = {}
+    lock_globals: Set[Tuple[str, str]] = set(locks.module)
+
+    summaries: Dict[FunctionInfo, _FnSummary] = {}
+
+    def summary(fn: FunctionInfo) -> _FnSummary:
+        if fn not in summaries:
+            mg = mod_globals.get(fn.module.name)
+            if mg is None:
+                mg = {n for n in _module_globals(fn.module)
+                      if (fn.module.name, n) not in lock_globals}
+                mod_globals[fn.module.name] = mg
+            summaries[fn] = _summarize(project, locks, fn, mg)
+        return summaries[fn]
+
+    # per-entrypoint must-hold fixpoint: inc[fn] = locks held on EVERY
+    # discovered path from the entry to fn (intersection; shrinking)
+    per_entry_access: Dict[AttrKey, Dict[int, List[_Access]]] = {}
+    for ei, entry in enumerate(entries):
+        inc: Dict[FunctionInfo, FrozenSet[str]] = {entry.fn: frozenset()}
+        work = [entry.fn]
+        while work:
+            fn = work.pop()
+            base = inc[fn]
+            for callee, lexical in summary(fn).calls:
+                held = base | lexical
+                prev = inc.get(callee)
+                new = held if prev is None else (prev & held)
+                if prev is None or new != prev:
+                    inc[callee] = new
+                    work.append(callee)
+        for fn, base in inc.items():
+            for key, kind, lexical, site in summary(fn).accesses:
+                if key[0] == "attr" and ("attr", key[1], key[2]) \
+                        in exempt_attrs:
+                    continue
+                if key[0] == "global" and key in exempt_globals:
+                    continue
+                per_entry_access.setdefault(key, {}).setdefault(
+                    ei, []).append(
+                    _Access(key, kind, base | lexical, site, fn))
+
+    findings: List[Finding] = []
+    for key in sorted(per_entry_access):
+        by_entry = per_entry_access[key]
+        eids = sorted(by_entry)
+        concurrent = len(eids) >= 2 \
+            or any(entries[ei].pooled for ei in eids)
+        if not concurrent:
+            continue
+        accesses = [a for ei in eids for a in by_entry[ei]]
+        writes = [a for a in accesses if a.kind == "write"]
+        if not writes:
+            continue
+        common = frozenset.intersection(*(a.guards for a in accesses))
+        if common:
+            continue
+        kind, owner, member = key
+        if kind == "attr":
+            short_owner = ".".join(owner.rsplit(".", 2)[-2:])
+            what = f"attribute `{short_owner}.{member}`"
+        else:
+            what = f"module global `{owner}.{member}`"
+        witnesses = sorted({entries[ei].label() for ei in eids})
+        unguarded = sorted({f"{os.path.basename(a.site[0])}:{a.site[1]}"
+                            for a in accesses if not a.guards})[:4]
+        # anchor at the racy side: the first unguarded write, else the
+        # first unguarded access, else the first write — so a justified
+        # `lint-ok[PT-RACE]` pragma lands on the line that IS the race
+        def first(cands: Sequence[_Access]) -> Optional[Site]:
+            sites = [a.site for a in cands]
+            return min(sites) if sites else None
+
+        anchor = first([a for a in writes if not a.guards]) \
+            or first([a for a in accesses if not a.guards]) \
+            or first(writes)
+        findings.append(Finding(
+            RULE, anchor[0], anchor[1], 0,
+            f"{what} is shared between thread entrypoints "
+            f"{', '.join(witnesses)} with a write and no common "
+            "named_lock guard on all access paths (unguarded sites: "
+            f"{', '.join(unguarded) or 'n/a'}) — a cross-thread data "
+            "race; guard every access with one named_lock, or make "
+            "the member a thread-safe primitive"))
+    return findings
+
+
+def run(project: Project) -> List[Finding]:
+    return analyze(project)

@@ -1,4 +1,5 @@
-"""Rule registry: code → ``run(project) -> [Finding]``."""
+"""Rule registry: code → ``run(project) -> [Finding]`` (+ one-line
+docs for the CLI's ``--list-rules``)."""
 
 from __future__ import annotations
 
@@ -8,6 +9,10 @@ from .metric_names import run as _metric
 from .recompile import run as _recompile
 from .resource import run as _resource
 from .trace_purity import run as _trace
+# racecheck imports rules.lock_order/.resource — keep it after them
+from ..racecheck import run as _race
+from .shape_check import run as _shape
+from .shard_check import run as _shard
 
 ALL_RULES = {
     "PT-TRACE": _trace,
@@ -16,6 +21,32 @@ ALL_RULES = {
     "PT-DTYPE": _dtype,
     "PT-LOCK": _lock,
     "PT-METRIC": _metric,
+    "PT-SHAPE": _shape,
+    "PT-SHARD": _shard,
+    "PT-RACE": _race,
 }
 
-__all__ = ["ALL_RULES"]
+#: One-line summaries, printed by ``python -m paddle_tpu.analysis
+#: --list-rules``.
+RULE_DOCS = {
+    "PT-TRACE": "host syncs/clocks/captured-container mutation inside "
+                "jit-reachable functions (trace purity)",
+    "PT-RECOMPILE": "jit cache hazards: jit-in-loop, build-and-discard, "
+                    "loop-var closures, f-string cache keys",
+    "PT-RESOURCE": "manual __enter__/__exit__, bare lock.acquire, "
+                   "silent broad except, unprefixed framework threads",
+    "PT-DTYPE": "direct jnp/lax contractions outside ops//core/ that "
+                "bypass the precision policy",
+    "PT-LOCK": "static lock-acquisition graph cycles and singleton "
+               "self-deadlocks (named_lock identities)",
+    "PT-METRIC": "dynamic metric/span names at registration sites "
+                 "(unbounded-cardinality leak)",
+    "PT-SHAPE": "shape/dtype contradictions in literal DSL model "
+                "configs (static netcheck front-end)",
+    "PT-SHARD": "broken literal ShardingRules tables: bad regexes, "
+                "shadowed duplicates, non-string mesh axes",
+    "PT-RACE": "state shared across ptpu-* thread entrypoints with a "
+               "write and no common named_lock guard",
+}
+
+__all__ = ["ALL_RULES", "RULE_DOCS"]

@@ -1,0 +1,510 @@
+"""PT-SHAPE — static shape/dtype verification of literal DSL configs.
+
+The runtime half of this rule is :mod:`paddle_tpu.analysis.netcheck`:
+an abstract interpreter over a built ``ModelConfig`` (symbolic shapes,
+abstract dtypes, full layer-path provenance) that the trainer's
+preflight and the tests drive directly.  This engine rule is the
+*static front-end*: it finds straight-line
+:mod:`paddle_tpu.config.dsl` model construction in the analyzed files,
+re-derives the layer records the DSL would build — sizes computed with
+the same formulas (``conv_out``, channel × image products) — and runs
+the SAME interpreter over them, anchoring each contradiction at the
+offending DSL call.
+
+Extraction is deliberately partial (the no-false-positive discipline):
+only literal/constant-foldable arguments and locally-assigned
+``LayerOutput`` variables are followed; a helper call, loop-carried
+variable, or non-literal size poisons the value and every check
+touching it is skipped.  What remains — a conv whose explicit
+``num_channels`` contradicts its input, a classification cost whose
+prediction width disagrees with its label's class count, an ``addto``
+over different widths, an embedding over a dense input — is exactly
+the class of config bug that otherwise explodes deep inside a jit
+trace with a reshape error and no layer name attached.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import netcheck
+from ..callgraph import FunctionInfo, ModuleInfo, Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-SHAPE"
+
+#: feeder input-type constructors: name → (kind, seq_level)
+_FEED_TYPES = {
+    "dense_vector": ("dense", 0),
+    "dense_vector_sequence": ("dense", 1),
+    "integer_value": ("index", 0),
+    "integer_value_sequence": ("index", 1),
+    "integer_value_sub_sequence": ("index", 2),
+    "sparse_binary_vector": ("sparse_binary", 0),
+    "sparse_float_vector": ("sparse_float", 0),
+}
+
+#: dsl constructors this extractor models.  Everything else poisons.
+_SUPPORTED = {
+    "data", "data_layer", "fc", "fc_layer", "embedding",
+    "embedding_layer", "img_conv", "img_conv_layer", "img_pool",
+    "img_pool_layer", "batch_norm", "batch_norm_layer", "addto",
+    "addto_layer", "concat", "concat_layer", "cos_sim", "dropout",
+    "dropout_layer", "pooling", "pooling_layer", "last_seq",
+    "first_seq", "classification_cost", "cross_entropy_cost",
+    "square_error_cost",
+}
+
+
+class _Rec:
+    """Statically-extracted layer record — the duck-typed LayerConfig
+    the netcheck interpreter consumes (plus the source line)."""
+
+    __slots__ = ("name", "type", "size", "active_type", "inputs",
+                 "attrs", "drop_rate", "error_clipping_threshold",
+                 "line", "channels", "img_x", "img_y")
+
+    def __init__(self, name: str, ltype: str, size: Optional[int],
+                 inputs: Sequence["_In"], attrs: Dict[str, Any],
+                 line: int):
+        self.name = name
+        self.type = ltype
+        self.size = size or 0
+        self.active_type = ""
+        self.inputs = list(inputs)
+        self.attrs = attrs
+        self.drop_rate = 0.0
+        self.error_clipping_threshold = 0.0
+        self.line = line
+        self.channels: Optional[int] = None
+        self.img_x: Optional[int] = None
+        self.img_y: Optional[int] = None
+
+
+class _In:
+    __slots__ = ("input_layer_name", "input_parameter_name", "proj",
+                 "attrs")
+
+    def __init__(self, name: str):
+        self.input_layer_name = name
+        self.input_parameter_name = ""
+        self.proj = None
+        self.attrs: Dict[str, Any] = {}
+
+
+class _Config:
+    """Duck-typed ModelConfig over the extracted records."""
+
+    def __init__(self, layers: Sequence[_Rec]):
+        self.layers = list(layers)
+        self.sub_models: list = []
+        self.output_layer_names: list = []
+        self.evaluators: list = []
+
+
+# ONE conv-geometry formula for the whole analysis package — the lint
+# front-end must never disagree with the runtime verifier it feeds
+_conv_out = netcheck._conv_out
+
+
+def _is_dsl_call(project: Project, mod: ModuleInfo,
+                 call: ast.Call) -> Optional[str]:
+    """The dsl constructor name this call invokes, or None."""
+    chain = dotted_name(call.func)
+    if chain is None:
+        return None
+    parts = chain.split(".")
+    leaf = parts[-1]
+    if leaf not in _SUPPORTED:
+        return None
+    if len(parts) == 1:
+        fi = mod.from_imports.get(leaf)
+        if fi is not None and (fi[0].endswith("config.dsl")
+                               or fi[0].endswith(".dsl")
+                               or fi[0] == "dsl"):
+            return leaf
+        return None
+    base = parts[0]
+    if project.names_module(mod, base, "paddle_tpu.config.dsl"):
+        return leaf
+    # `from paddle_tpu.config import dsl` / `from ..config import dsl`
+    fi = mod.from_imports.get(base)
+    if fi is not None and fi[1] == "dsl":
+        return leaf
+    return None
+
+
+def _feed_type_of(project: Project, mod: ModuleInfo, node: ast.AST,
+                  consts: Dict[str, int]
+                  ) -> Optional[Tuple[str, int, Optional[int]]]:
+    """``dense_vector(128)``-style expression → (kind, seq_level, dim)."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = dotted_name(node.func)
+    if chain is None:
+        return None
+    leaf = chain.split(".")[-1]
+    if leaf not in _FEED_TYPES:
+        return None
+    kind, seq = _FEED_TYPES[leaf]
+    dim = _int_of(node.args[0], consts) if node.args else None
+    return kind, seq, dim
+
+
+def _int_of(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+    """Constant-fold an int expression (literals, +-*//, named module/
+    local int constants); None when not statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp):
+        left = _int_of(node.left, consts)
+        right = _int_of(node.right, consts)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.FloorDiv) and right:
+            return left // right
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _int_of(node.operand, consts)
+        return -v if v is not None else None
+    return None
+
+
+def _kw(call: ast.Call, name: str, pos: Optional[int] = None
+        ) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+class _Extractor:
+    """Straight-line symbolic execution of one scope's dsl calls."""
+
+    def __init__(self, project: Project, mod: ModuleInfo,
+                 fn: Optional[FunctionInfo]):
+        self.project = project
+        self.mod = mod
+        self.fn = fn
+        self.env: Dict[str, _Rec] = {}      # var -> layer record
+        self.consts: Dict[str, int] = {}    # var -> folded int
+        self.records: List[_Rec] = []
+        self._n = 0
+
+    def _fresh(self, ltype: str) -> str:
+        self._n += 1
+        return f"__{ltype}_{self._n}__"
+
+    # -------------------------------------------------------- statements
+    def run(self, body: Sequence[ast.stmt]) -> List[_Rec]:
+        self._stmts(body)
+        return self.records
+
+    def _stmts(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                self._assign(stmt.targets[0].id, stmt.value)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                # any other rebinding shape (tuple unpack, chained
+                # a = b = ..., annotated, augmented) invalidates the
+                # old bindings — a stale record would turn valid code
+                # into a false positive
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.env.pop(n.id, None)
+                            self.consts.pop(n.id, None)
+            elif isinstance(stmt, ast.Expr):
+                self._eval(stmt.value)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._stmts(stmt.body)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                self._eval(stmt.value)
+            elif isinstance(stmt, (ast.For, ast.While, ast.If, ast.Try)):
+                # control flow: poison every name bound inside — the
+                # extractor only trusts straight-line construction
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, ast.Store):
+                        self.env.pop(n.id, None)
+                        self.consts.pop(n.id, None)
+
+    def _assign(self, name: str, value: ast.AST) -> None:
+        iv = _int_of(value, self.consts)
+        if iv is not None:
+            self.consts[name] = iv
+            self.env.pop(name, None)
+            return
+        rec = self._eval(value)
+        if rec is not None:
+            self.env[name] = rec
+        else:
+            self.env.pop(name, None)
+            self.consts.pop(name, None)
+
+    # ------------------------------------------------------- expressions
+    def _value(self, node: ast.AST) -> Optional[_Rec]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Call):
+            return self._eval(node)
+        return None
+
+    def _values(self, node: ast.AST) -> List[Optional[_Rec]]:
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return [self._value(e) for e in node.elts]
+        v = self._value(node)
+        return [v]
+
+    def _unknown_input(self, line: int) -> _Rec:
+        """Placeholder producer for a value the extractor can't see —
+        keeps the interpreter from reporting missing producers on
+        partial extractions."""
+        rec = _Rec(self._fresh("opaque"), "data", None, [],
+                   {"kind": "?", "seq_level": 0}, line)
+        self.records.append(rec)
+        return rec
+
+    def _input_names(self, vals: List[Optional[_Rec]],
+                     line: int) -> List[_In]:
+        out = []
+        for v in vals:
+            rec = v if v is not None else self._unknown_input(line)
+            out.append(_In(rec.name))
+        return out
+
+    def _eval(self, node: ast.AST) -> Optional[_Rec]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = _is_dsl_call(self.project, self.mod, node)
+        if name is None:
+            return None
+        line = node.lineno
+        C = self.consts
+        if name in ("data", "data_layer"):
+            tnode = _kw(node, "type", 1)
+            ft = _feed_type_of(self.project, self.mod, tnode, C) \
+                if tnode is not None else None
+            size_node = _kw(node, "size")
+            if ft is None and size_node is not None:
+                ft = ("dense", 0, _int_of(size_node, C))
+            if ft is None and tnode is not None:
+                iv = _int_of(tnode, C)      # v1: data_layer(name, size)
+                if iv is not None:
+                    ft = ("dense", 0, iv)
+            kind, seq, dim = ft if ft else ("dense", 0, None)
+            lname = self._layer_name(node, f"__data_{line}__")
+            rec = _Rec(lname, "data", dim, [],
+                       {"kind": kind, "seq_level": seq}, line)
+            self.records.append(rec)
+            return rec
+        if name in ("fc", "fc_layer"):
+            vals = self._values(_kw(node, "input", 0) or ast.Tuple(
+                elts=[], ctx=ast.Load()))
+            size = _int_of(_kw(node, "size", 1) or ast.Constant(None), C)
+            rec = _Rec(self._fresh("fc"), "fc", size,
+                       self._input_names(vals, line), {}, line)
+            self.records.append(rec)
+            return rec
+        if name in ("embedding", "embedding_layer"):
+            vals = self._values(_kw(node, "input", 0)
+                                or ast.Constant(None))
+            size = _int_of(_kw(node, "size", 1) or ast.Constant(None), C)
+            rec = _Rec(self._fresh("embedding"), "embedding", size,
+                       self._input_names(vals[:1], line), {}, line)
+            self.records.append(rec)
+            return rec
+        if name in ("img_conv", "img_conv_layer"):
+            return self._conv(node, line)
+        if name in ("img_pool", "img_pool_layer"):
+            return self._pool(node, line)
+        if name in ("batch_norm", "batch_norm_layer"):
+            return self._bn(node, line)
+        if name in ("addto", "addto_layer", "concat", "concat_layer"):
+            vals = self._values(_kw(node, "input", 0)
+                                or ast.Constant(None))
+            base = name.split("_")[0]
+            known = [v.size for v in vals if v is not None and v.size]
+            if base == "addto":
+                size = known[0] if known else None
+            else:
+                size = sum(known) if vals and all(
+                    v is not None and v.size for v in vals) else None
+            rec = _Rec(self._fresh(base), base, size,
+                       self._input_names(vals, line), {}, line)
+            self.records.append(rec)
+            return rec
+        if name == "cos_sim":
+            a = self._value(_kw(node, "a", 0) or ast.Constant(None))
+            b = self._value(_kw(node, "b", 1) or ast.Constant(None))
+            rec = _Rec(self._fresh("cos_sim"), "cos_sim", 1,
+                       self._input_names([a, b], line), {}, line)
+            self.records.append(rec)
+            return rec
+        if name in ("dropout", "dropout_layer", "pooling",
+                    "pooling_layer", "last_seq", "first_seq"):
+            vals = self._values(_kw(node, "input", 0)
+                                or ast.Constant(None))
+            src = vals[0]
+            ltype = {"dropout": "dropout", "dropout_layer": "dropout",
+                     "pooling": "pooling", "pooling_layer": "pooling",
+                     "last_seq": "seqlastins",
+                     "first_seq": "seqfirstins"}[name]
+            rec = _Rec(self._fresh(ltype), ltype,
+                       src.size if src is not None else None,
+                       self._input_names([src], line), {}, line)
+            self.records.append(rec)
+            return rec
+        if name in ("classification_cost", "cross_entropy_cost",
+                    "square_error_cost"):
+            pred = self._value(_kw(node, "input", 0)
+                               or ast.Constant(None))
+            lab = self._value(_kw(node, "label", 1)
+                              or ast.Constant(None))
+            ltype = {"classification_cost": "multi-class-cross-entropy",
+                     "cross_entropy_cost": "multi-class-cross-entropy",
+                     "square_error_cost": "square_error"}[name]
+            rec = _Rec(self._fresh("cost"), ltype, 1,
+                       self._input_names([pred, lab], line), {}, line)
+            self.records.append(rec)
+            return rec
+        return None
+
+    def _layer_name(self, node: ast.Call, default: str) -> str:
+        arg = _kw(node, "name", 0)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        return default
+
+    # geometry constructors mirror the DSL's own derivations exactly
+    def _conv(self, node: ast.Call, line: int) -> Optional[_Rec]:
+        C = self.consts
+        src = self._value(_kw(node, "input", 0) or ast.Constant(None))
+        fs = _int_of(_kw(node, "filter_size", 1)
+                     or ast.Constant(None), C)
+        nf = _int_of(_kw(node, "num_filters", 2)
+                     or ast.Constant(None), C)
+        nc = _kw(node, "num_channels")
+        stride = _int_of(_kw(node, "stride") or ast.Constant(1), C)
+        pad = _int_of(_kw(node, "padding") or ast.Constant(0), C)
+        groups = _int_of(_kw(node, "groups") or ast.Constant(1), C)
+        c = _int_of(nc, C) if nc is not None else (
+            src.channels if src is not None else 1) or 1
+        img_kw = _kw(node, "img_size")
+        img = _int_of(img_kw, C) if img_kw is not None else None
+        if img is None and src is not None:
+            img = src.img_x
+        if img is None and src is not None and src.size and c:
+            img = int(round((src.size / c) ** 0.5))
+        if None in (fs, nf, stride, pad, img) or not c:
+            return None
+        out_x = _conv_out(img, fs, pad, stride)
+        attrs = {"channels": c, "filter_size": fs, "num_filters": nf,
+                 "stride": stride, "padding": pad, "groups": groups or 1,
+                 "img_size": img, "img_size_y": img,
+                 "output_x": out_x, "output_y": out_x}
+        rec = _Rec(self._fresh("conv"), "exconv",
+                   nf * out_x * out_x if out_x > 0 else None,
+                   self._input_names([src], line), attrs, line)
+        rec.channels, rec.img_x, rec.img_y = nf, out_x, out_x
+        self.records.append(rec)
+        return rec
+
+    def _pool(self, node: ast.Call, line: int) -> Optional[_Rec]:
+        C = self.consts
+        src = self._value(_kw(node, "input", 0) or ast.Constant(None))
+        ps = _int_of(_kw(node, "pool_size", 1) or ast.Constant(None), C)
+        stride = _int_of(_kw(node, "stride") or ast.Constant(2), C)
+        pad = _int_of(_kw(node, "padding") or ast.Constant(0), C)
+        nc = _kw(node, "num_channels")
+        c = _int_of(nc, C) if nc is not None else (
+            src.channels if src is not None else 1) or 1
+        img = src.img_x if src is not None else None
+        if img is None and src is not None and src.size and c:
+            img = int(round((src.size / c) ** 0.5))
+        if None in (ps, stride, pad, img) or not c:
+            return None
+        out_x = _conv_out(img, ps, pad, stride)
+        attrs = {"channels": c, "pool_size": ps, "stride": stride,
+                 "padding": pad, "img_size": img, "img_size_y": img}
+        rec = _Rec(self._fresh("pool"), "pool",
+                   c * out_x * out_x if out_x > 0 else None,
+                   self._input_names([src], line), attrs, line)
+        rec.channels, rec.img_x, rec.img_y = c, out_x, out_x
+        self.records.append(rec)
+        return rec
+
+    def _bn(self, node: ast.Call, line: int) -> Optional[_Rec]:
+        C = self.consts
+        src = self._value(_kw(node, "input", 0) or ast.Constant(None))
+        nc = _kw(node, "num_channels")
+        c = _int_of(nc, C) if nc is not None else (
+            src.channels if src is not None else None)
+        if c is None and src is not None:
+            c = src.size
+        attrs: Dict[str, Any] = {}
+        if c:
+            attrs["channels"] = c
+        if src is not None and src.img_x:
+            attrs["img_size"] = src.img_x
+            attrs["img_size_y"] = src.img_y or src.img_x
+        rec = _Rec(self._fresh("batch_norm"), "batch_norm",
+                   src.size if src is not None else None,
+                   self._input_names([src], line), attrs, line)
+        if src is not None:
+            rec.channels = c
+            rec.img_x, rec.img_y = src.img_x, src.img_y
+        self.records.append(rec)
+        return rec
+
+
+def _scopes(mod: ModuleInfo):
+    """Module body + every function body, each its own extraction."""
+    yield None, mod.tree.body
+    for fn in mod.functions.values():
+        yield fn, fn.node.body
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        # cheap pre-filter: no dsl import, no extraction
+        has_dsl = any(v == "dsl" or v.endswith(".dsl")
+                      for v in mod.imports.values()) \
+            or any(fi[1] == "dsl" or fi[0].endswith(".dsl")
+                   or fi[0] == "dsl"
+                   for fi in mod.from_imports.values())
+        if not has_dsl:
+            continue
+        for fn, body in _scopes(mod):
+            ex = _Extractor(project, mod, fn)
+            records = ex.run(body)
+            if not records:
+                continue
+            cfg = _Config(records)
+            lines = {r.name: r.line for r in records}
+            for issue in netcheck.check_model(cfg):
+                if issue.severity != "error":
+                    continue
+                line = lines.get(issue.where, records[0].line)
+                prov = " -> ".join(issue.path)
+                out.append(Finding(
+                    RULE, mod.path, line, 0,
+                    f"{issue.message}"
+                    + (f" [layer path: {prov}]" if prov else "")))
+    return out

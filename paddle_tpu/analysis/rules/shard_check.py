@@ -1,0 +1,150 @@
+"""PT-SHARD — static verification of literal ``ShardingRules`` tables.
+
+The runtime half is :func:`paddle_tpu.analysis.netcheck.check_sharding`
+(driven by ``ShardingRules.verify`` and the ``dryrun_multichip``
+preflight): it needs a real parameter tree and a mesh topology, which
+only exist at run time.  This engine rule checks what IS static about a
+rule table — the literals at the construction site:
+
+- a pattern that does not compile (``re.error``) — the rule can never
+  match and ``spec_for`` would raise at first use;
+- a pattern identical to an earlier rule's in the same table — under
+  first-match-wins the later rule is dead (identical spec: duplicate;
+  different spec: silently shadowed, the dangerous one);
+- a ``PartitionSpec`` entry that is a non-string constant — mesh axes
+  are named, ``P(0)`` never matches an axis.
+
+Recognized sites: ``ShardingRules([ (pattern, P(...)), ... ])``
+constructions and ``<rules>.add(pattern, P(...))`` calls.  Non-literal
+patterns/specs are skipped (no-false-positive discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..callgraph import ModuleInfo, Project, dotted_name
+from ..engine import Finding
+
+RULE = "PT-SHARD"
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+
+def _literal_pattern(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _spec_key(node: ast.AST) -> Optional[Tuple]:
+    """Structural identity of a literal P(...) spec (None = not a
+    statically readable spec)."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = dotted_name(node.func)
+    if chain is None or chain.split(".")[-1] not in _SPEC_NAMES:
+        return None
+    key: List = []
+    for a in node.args:
+        if isinstance(a, ast.Constant):
+            key.append(a.value)
+        elif isinstance(a, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) for e in a.elts):
+            key.append(tuple(e.value for e in a.elts))
+        else:
+            return None
+    return tuple(key)
+
+
+def _check_spec_args(mod: ModuleInfo, spec: ast.AST,
+                     out: List[Finding]) -> None:
+    if not isinstance(spec, ast.Call):
+        return
+    chain = dotted_name(spec.func)
+    if chain is None or chain.split(".")[-1] not in _SPEC_NAMES:
+        return
+    for a in spec.args:
+        consts = [a] if isinstance(a, ast.Constant) else (
+            [e for e in a.elts if isinstance(e, ast.Constant)]
+            if isinstance(a, (ast.Tuple, ast.List)) else [])
+        for c in consts:
+            if c.value is not None and not isinstance(c.value, str):
+                out.append(Finding(
+                    RULE, mod.path, c.lineno, c.col_offset,
+                    f"PartitionSpec entry {c.value!r} is not a mesh-"
+                    "axis NAME — axes are strings ('data', 'model'); "
+                    "a non-string entry never matches an axis"))
+
+
+def _check_pattern(mod: ModuleInfo, node: ast.AST,
+                   pattern: str, out: List[Finding]) -> None:
+    try:
+        re.compile(pattern)
+    except re.error as e:
+        out.append(Finding(
+            RULE, mod.path, node.lineno, node.col_offset,
+            f"sharding-rule pattern {pattern!r} does not compile "
+            f"({e}) — ShardingRules would raise at construction/first "
+            "use"))
+
+
+def _table_entries(ctor: ast.Call):
+    """(pattern_node, spec_node) pairs of a literal ctor table."""
+    table = ctor.args[0] if ctor.args else None
+    if not isinstance(table, (ast.List, ast.Tuple)):
+        return
+    for entry in table.elts:
+        if isinstance(entry, (ast.Tuple, ast.List)) \
+                and len(entry.elts) == 2:
+            yield entry.elts[0], entry.elts[1]
+
+
+def run(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            if chain is None:
+                continue
+            leaf = chain.split(".")[-1]
+            if leaf == "ShardingRules":
+                seen: Dict[str, Tuple[int, Optional[Tuple]]] = {}
+                for pat_node, spec_node in _table_entries(node):
+                    pattern = _literal_pattern(pat_node)
+                    _check_spec_args(mod, spec_node, out)
+                    if pattern is None:
+                        continue
+                    _check_pattern(mod, pat_node, pattern, out)
+                    key = _spec_key(spec_node)
+                    prev = seen.get(pattern)
+                    if prev is not None:
+                        prev_line, prev_key = prev
+                        same = (key is not None and key == prev_key)
+                        out.append(Finding(
+                            RULE, mod.path, pat_node.lineno,
+                            pat_node.col_offset,
+                            f"pattern {pattern!r} duplicates the rule "
+                            f"on line {prev_line} — first-match-wins "
+                            + ("makes this entry dead (identical "
+                               "spec); drop it"
+                               if same else
+                               "means this entry NEVER fires and its "
+                               "different spec is silently shadowed")))
+                    else:
+                        seen[pattern] = (pat_node.lineno, key)
+            elif leaf == "add" and isinstance(node.func, ast.Attribute):
+                # <rules>.add(pattern, P(...)): check the literals —
+                # only when the spec side looks like a PartitionSpec,
+                # so unrelated .add(str, x) calls never match
+                if len(node.args) >= 2 \
+                        and _spec_key(node.args[1]) is not None:
+                    pattern = _literal_pattern(node.args[0])
+                    if pattern is not None:
+                        _check_pattern(mod, node.args[0], pattern, out)
+                    _check_spec_args(mod, node.args[1], out)
+    return out

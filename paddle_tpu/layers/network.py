@@ -114,120 +114,30 @@ class NeuralNetwork:
                     and pconf.error_clipping_threshold == 0:
                 self._cost_logit_alias[cname] = pname + ".logits"
 
-        # conv→BN fusion peephole: a batch-norm whose sole producer is a
-        # linear 3×3 stride-1 pad-1 conv consumed by nothing else routes
-        # through the fused conv+BN op (ops/nn_ops.py::conv2d_bn — the
-        # Pallas backward-data kernel with the BN-backward affine folded
-        # into its input pipeline).  Mirrors the logits peephole above:
-        # pattern-matched once at build time on the static config; the
-        # op itself re-gates on shapes/dtype and falls back to the exact
-        # unfused composition, so firing is always semantics-preserving.
+        # conv→BN fusion peepholes: a batch-norm whose sole producer is
+        # a linear 3×3 stride-1 pad-1 conv routes through the fused
+        # conv+BN op (ops/nn_ops.py::conv2d_bn — the Pallas backward-
+        # data kernel with the BN-backward affine folded into its input
+        # pipeline), and a batch-norm whose sole consumer is a fusable
+        # conv defers its normalize+act apply pass into that conv's
+        # input prologue (nn_ops.affine_act_conv2d) so the normalized
+        # activation never round-trips HBM.  Pattern-matched once at
+        # build time on the static config — the resolution itself lives
+        # in :func:`paddle_tpu.analysis.netcheck.fusion_plan` (pure
+        # function of the config, shared with the static verifier so
+        # the PT-SHAPE census can never drift from the gauge below);
+        # the ops re-gate on shapes/dtype at trace time and fall back
+        # to the exact unfused composition, so firing is always
+        # semantics-preserving.  Kill switches: --conv_bn_fuse (bwd),
+        # --conv_bn_fuse_fwd (fwd).
+        from ..analysis import netcheck
         from ..utils import FLAGS
 
-        self._conv_bn_fuse: Dict[str, str] = {}
-        all_conv_types = ("exconv", "cudnn_conv", "conv", "mkldnn_conv")
-        # A/B kill switch (--conv_bn_fuse=false)
-        conv_types = all_conv_types if FLAGS.get("conv_bn_fuse") else ()
-        bn_types = ("batch_norm", "cudnn_batch_norm", "mkldnn_batch_norm")
-        n_consumers: Dict[str, int] = {}
-        for lc in config.layers:
-            for iname in lc.input_names():
-                n_consumers[iname] = n_consumers.get(iname, 0) + 1
-        # consumers that read values by name OUTSIDE layer input lists:
-        # group in/out links, memory boot layers, generator static
-        # inputs, and evaluator inputs — a conv referenced by any of
-        # these must keep its standalone value
-        extra_consumers: Set[str] = set()
-        for sm in config.sub_models:
-            if sm.name == "root":
-                continue
-            extra_consumers.update(sm.in_links)
-            extra_consumers.update(sm.out_links)
-            for m in sm.memories:
-                if m.get("boot_layer_name"):
-                    extra_consumers.add(m["boot_layer_name"])
-            extra_consumers.update(sm.generator.get("static_inputs", ()))
-        for ev in config.evaluators:
-            for key in ("input_layer_name", "label_layer_name"):
-                if ev.get(key):
-                    extra_consumers.add(ev[key])
-        outputs = set(self.output_names) | extra_consumers
-        for lconf in config.layers:
-            if lconf.type not in bn_types or len(lconf.inputs) != 1 \
-                    or lconf.name not in self.layers:
-                continue
-            pname = lconf.inputs[0].input_layer_name
-            pconf = lmap.get(pname)
-            if pconf is None or pconf.type not in conv_types \
-                    or pname not in self.layers:
-                continue
-            a = pconf.attrs
-            f = a.get("filter_size")
-            s = a.get("stride", 1)
-            p = a.get("padding", 0)
-            if (f == 3 and a.get("filter_size_y", f) == 3
-                    and s == 1 and a.get("stride_y", s) == 1
-                    and p == 1 and a.get("padding_y", p) == 1
-                    and a.get("groups", 1) == 1
-                    and len(pconf.inputs) == 1
-                    and pconf.active_type in ("", "linear")
-                    and pconf.drop_rate == 0
-                    and pconf.error_clipping_threshold == 0
-                    and n_consumers.get(pname, 0) == 1
-                    and pname not in outputs):
-                self._conv_bn_fuse[lconf.name] = pname
-
-        # BN(+ReLU)→conv FORWARD-fusion peephole (the other direction):
-        # a batch-norm whose sole consumer is a fusable conv defers its
-        # normalize+act apply pass — it publishes (z, a, c) and the conv
-        # streams act(a·z + c) through its input pipeline
-        # (nn_ops.affine_act_conv2d: Pallas 3×3 kernel / 1×1 GEMM
-        # prologue), so the normalized activation never round-trips
-        # HBM.  Same build-time pattern-match discipline as above; the
-        # ops re-gate on shapes and fall back to the exact unfused
-        # composition.  Maps consumer conv name → deferred BN name.
-        self._bn_conv_fuse: Dict[str, str] = {}
-        if FLAGS.get("conv_bn_fuse_fwd"):
-            for lconf in config.layers:     # lconf = the consuming conv
-                if lconf.type not in all_conv_types \
-                        or len(lconf.inputs) != 1 \
-                        or lconf.name not in self.layers:
-                    continue
-                a = lconf.attrs
-                f = a.get("filter_size")
-                fy = a.get("filter_size_y", f)
-                s = a.get("stride", 1)
-                sy = a.get("stride_y", s)
-                p = a.get("padding", 0)
-                py = a.get("padding_y", p)
-                geom3 = (f == 3 and fy == 3 and s == 1 and sy == 1
-                         and p == 1 and py == 1)
-                geom1 = (f == 1 and fy == 1 and s == 1 and sy == 1
-                         and p == 0 and py == 0)
-                if not (geom3 or geom1) or a.get("groups", 1) != 1:
-                    continue
-                pname = lconf.inputs[0].input_layer_name
-                pconf = lmap.get(pname)
-                if pconf is None or pconf.type not in bn_types \
-                        or pname not in self.layers:
-                    continue
-                if (pconf.active_type not in ("", "linear", "relu")
-                        or pconf.drop_rate != 0
-                        or pconf.error_clipping_threshold != 0
-                        or len(pconf.inputs) != 1
-                        or pconf.attrs.get("img_size") is None):
-                    continue
-                if n_consumers.get(pname, 0) != 1 or pname in outputs:
-                    continue
-                self._bn_conv_fuse[lconf.name] = pname
-            # a deferred BN publishes (z, a, c) instead of its applied
-            # output, so it can no longer be the OUTPUT of a
-            # backward-fused pair — its upstream conv reverts to a
-            # standalone value.  (A round-6 entry whose CONV is a fwd
-            # consumer stays: that pair runs as the chain op with the
-            # deferred affine as its input prologue.)
-            for bn in self._bn_conv_fuse.values():
-                self._conv_bn_fuse.pop(bn, None)
+        self._conv_bn_fuse, self._bn_conv_fuse = netcheck.fusion_plan(
+            config, root_layers=set(self.layers),
+            output_names=self.output_names,
+            fuse_bwd=bool(FLAGS.get("conv_bn_fuse")),
+            fuse_fwd=bool(FLAGS.get("conv_bn_fuse_fwd")))
 
         # fused-pair census: how many conv/BN pairs THIS topology
         # resolved at build time, per direction and kernel family —
@@ -261,6 +171,22 @@ class NeuralNetwork:
                 "resolved at build time").inc(
             compute=dtype_name(pol.compute_dtype),
             output=dtype_name(pol.output_dtype))
+
+    def verify(self) -> list:
+        """Config-time whole-graph verification — the
+        :mod:`paddle_tpu.analysis.netcheck` abstract interpreter over
+        this network's config (symbolic shapes + policy-resolved
+        dtypes, no tracing).  Returns the issue list;
+        ``netcheck.errors(...)`` filters the trace-fatal subset.  The
+        reference verified its proto config before any kernel ran;
+        this is that check for the rebuild."""
+        from ..analysis import netcheck
+        from ..core.dtypes import current_policy, dtype_name
+
+        pol = current_policy()
+        return netcheck.check_model(
+            self.config, policy=(dtype_name(pol.compute_dtype),
+                                 dtype_name(pol.output_dtype)))
 
     def _collect_specs(self, layers, declared) -> None:
         for layer in layers:

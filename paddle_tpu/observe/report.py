@@ -286,5 +286,8 @@ def active() -> bool:
 def prometheus_dump() -> str:
     """On-demand Prometheus text over the default registry + timers
     (works with or without a running reporter)."""
+    # benign racy read: writes are _global_lock-guarded; a scrape
+    # racing stop_global reads the old reporter or a fresh throwaway
+    # ptpu: lint-ok[PT-RACE] atomic reference read, writes lock-guarded
     r = _global or MetricsReporter()
     return r.prometheus_text()

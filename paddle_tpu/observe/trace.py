@@ -247,6 +247,10 @@ _atexit_installed = False
 
 def enabled() -> bool:
     """True iff spans are being recorded — the hot-path gate."""
+    # benign racy read on the span hot path: every write is
+    # _state_lock-guarded; a stale recorder finishes one span into the
+    # old ring harmlessly — taking the lock here would price every span
+    # ptpu: lint-ok[PT-RACE] atomic reference read, writes lock-guarded
     return _recorder is not None
 
 

@@ -16,7 +16,8 @@ annotations (SURVEY §2.5 mapping):
 """
 
 from .sharding import (ShardingRules, tp_rules, shard_params,
-                       constraint)  # noqa: F401
+                       constraint, param_dims_of,
+                       verify_rules_or_raise)  # noqa: F401
 from .ring_attention import (ring_attention, ulysses_attention,
                              full_attention)  # noqa: F401
 from ..ops.pallas_attention import flash_attention  # noqa: F401

@@ -43,6 +43,47 @@ class ShardingRules:
         mesh = mesh or get_mesh()
         return NamedSharding(mesh, self.spec_for(name, ndim))
 
+    def verify(self, param_dims: Dict[str, Sequence[int]],
+               mesh_axes: Optional[Dict[str, int]] = None,
+               strict: bool = False) -> list:
+        """Statically verify this table against a model's parameter
+        tree on one mesh topology (PT-SHARD,
+        :func:`paddle_tpu.analysis.netcheck.check_sharding`): unmatched
+        and ambiguously-matched params are flagged, spec ranks checked
+        against param ranks, and every sharded dim checked for
+        mesh-axis divisibility — milliseconds instead of a pod-compile
+        failure.  Returns the issue list; errors are compile-fatal."""
+        from ..analysis import netcheck
+
+        if mesh_axes is None:
+            mesh = get_mesh()
+            mesh_axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        return netcheck.check_sharding(self, param_dims, mesh_axes,
+                                       strict=strict)
+
+
+def param_dims_of(net) -> Dict[str, List[int]]:
+    """A NeuralNetwork's parameter tree as name → dims, the shape
+    :meth:`ShardingRules.verify` consumes (no arrays materialized)."""
+    return {n: list(s.dims) if s.dims else [s.size]
+            for n, s in net.param_specs.items()}
+
+
+def verify_rules_or_raise(rules: "ShardingRules",
+                          param_dims: Dict[str, Sequence[int]],
+                          mesh_axes: Dict[str, int]) -> None:
+    """Preflight: raise ``PaddleTpuError`` listing every error-severity
+    finding (a bad rule fails fast, before anything compiles)."""
+    from ..analysis import netcheck
+    from ..utils import PaddleTpuError
+
+    errs = netcheck.errors(rules.verify(param_dims, mesh_axes))
+    if errs:
+        raise PaddleTpuError(
+            f"sharding preflight failed on mesh {mesh_axes} "
+            f"({len(errs)} error(s)):\n"
+            + "\n".join("  " + e.render() for e in errs))
+
 
 def tp_rules(model_axis: str = MODEL_AXIS) -> ShardingRules:
     """Default tensor-parallel ruleset for the layer engine's parameter

@@ -1,0 +1,79 @@
+"""PT-RACE fixture: thread shapes that must NOT be flagged.
+
+The false-positive contract: state guarded by one common named_lock on
+every path, ``__init__``-only construction (happens-before the thread
+starts), thread-safe primitives as members, state touched by only one
+entrypoint, and read-only sharing.
+"""
+import queue
+import threading
+
+from paddle_tpu.analysis.lockorder import named_condition, named_lock
+
+
+class GuardedPipeline:
+    def __init__(self, src):
+        self._cond = named_condition("fixture.queue")
+        self._lock = named_lock("fixture.state")
+        self._src = src                 # written in __init__ only
+        self._q = queue.Queue()         # thread-safe primitive
+        self._ready = {}
+        self._seq = 0
+        self._threads = [
+            threading.Thread(target=self._worker, name="ptpu-cfx-w"),
+            threading.Thread(target=self._drainer, name="ptpu-cfx-d"),
+        ]
+
+    def _worker(self):
+        item = self._q.get()
+        with self._cond:
+            self._ready[self._seq] = item       # common guard
+            self._seq += 1
+            self._cond.notify_all()
+
+    def _drainer(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait(0.1)
+            self._ready.clear()                 # same guard
+
+    def _helper_under_lock(self):
+        # called ONLY with the lock held (interprocedural must-hold)
+        self._seq += 1
+
+    def _locked_entry(self):
+        with self._cond:
+            self._helper_under_lock()
+
+    def start_locked(self):
+        t = threading.Thread(target=self._locked_entry,
+                             name="ptpu-cfx-l")
+        t.start()
+
+
+class SingleWriter:
+    """One entrypoint owns the state; nothing else touches it."""
+
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._only, name="ptpu-cfx-s")
+
+    def _only(self):
+        self.count += 1
+
+
+class ReadOnlyFanout:
+    """Two entrypoints only READ a config dict set before start()."""
+
+    def __init__(self, cfg):
+        self.cfg = dict(cfg)
+        self._threads = [
+            threading.Thread(target=self._a, name="ptpu-cfx-ra"),
+            threading.Thread(target=self._b, name="ptpu-cfx-rb"),
+        ]
+
+    def _a(self):
+        return self.cfg.get("a")
+
+    def _b(self):
+        return self.cfg.get("b")

@@ -1,0 +1,21 @@
+"""PT-RACE fixture: deliberate benign races under justified pragmas."""
+import threading
+
+
+class LatestWins:
+    """A monotone 'latest sample' cell where torn ordering is
+    acceptable by design (the trace-recorder pattern)."""
+
+    def __init__(self):
+        self.sample = None
+        self._threads = [
+            threading.Thread(target=self._producer, name="ptpu-sfx-a"),
+            threading.Thread(target=self._consumer, name="ptpu-sfx-b"),
+        ]
+
+    def _producer(self):
+        # ptpu: lint-ok[PT-RACE] benign: atomic ref store, latest wins
+        self.sample = object()
+
+    def _consumer(self):
+        return self.sample

@@ -1,0 +1,45 @@
+"""PT-RACE fixture: state shared across ptpu-* threads, unguarded.
+
+Three violation classes, line-pinned: an attribute written by two
+distinct entrypoints with no lock at all, an attribute where only ONE
+side takes the lock (no common guard on all paths), and a module
+global mutated from a pooled worker.
+"""
+import threading
+
+from paddle_tpu.analysis.lockorder import named_lock
+
+_seen = []                               # module global
+
+
+class Collector:
+    def __init__(self):
+        self._lock = named_lock("fixture.collector")
+        self.total = 0
+        self.latest = None
+        self._threads = [
+            threading.Thread(target=self._worker, name="ptpu-fix-w"),
+            threading.Thread(target=self._reporter, name="ptpu-fix-r"),
+        ]
+
+    def _worker(self):
+        self.total += 1                  # line 26: write, no lock
+        _seen.append(self.total)         # line 27: global, no lock
+
+    def _reporter(self):
+        with self._lock:
+            self.latest = self.total     # guarded here only
+
+    def _flusher(self):
+        self.latest = None               # line 34: unguarded write
+
+    def start_flusher(self):
+        t = threading.Thread(target=self._flusher, name="ptpu-fix-f")
+        t.start()
+
+
+def pool():
+    c = Collector()
+    ts = [threading.Thread(target=c._worker, name=f"ptpu-fix-p{i}")
+          for i in range(4)]
+    return ts

@@ -1,0 +1,59 @@
+"""PT-SHAPE fixture: near-miss shapes that must NOT be flagged.
+
+The false-positive contract: consistent geometry, unknown values
+(helper calls, parameters, loop-carried names) poisoning checks, and a
+non-dsl function that happens to share a dsl constructor's name.
+"""
+from paddle_tpu.config import dsl
+from paddle_tpu.data.feeder import dense_vector, integer_value
+
+
+def consistent_config():
+    img = dsl.data("image", dense_vector(3 * 16 * 16))
+    conv = dsl.img_conv(img, filter_size=3, num_filters=8,
+                        num_channels=3, padding=1)
+    pool = dsl.img_pool(conv, pool_size=2, stride=2)
+    bn = dsl.batch_norm(pool)
+    pred = dsl.fc(bn, size=2, act=None)
+    lab = dsl.data("label", integer_value(2))
+    return dsl.classification_cost(pred, lab)
+
+
+def unknown_values_poison(encoder_output, width):
+    # inputs from parameters are opaque: no checks may fire
+    pred = dsl.fc(encoder_output, size=10, act=None)
+    emb = dsl.embedding(encoder_output, size=16)
+    lab = dsl.data("label", integer_value(2))
+    return dsl.classification_cost(pred, lab), emb, width
+
+
+def loop_carried_is_poisoned():
+    net = dsl.data("x", dense_vector(64))
+    for _ in range(3):
+        net = dsl.fc(net, size=32, act=None)
+    # net is loop-carried here: unknown, so no width check fires
+    lab = dsl.data("label", integer_value(2))
+    return dsl.classification_cost(net, lab)
+
+
+def rebinding_shapes_invalidate(helper):
+    # tuple-unpack / chained / augmented rebindings must POISON the old
+    # record — a stale width here would flag this valid config
+    b = dsl.fc(dsl.data("r1", dense_vector(8)), size=8)
+    b, extra = helper(), 1
+    n = 8
+    n += 8
+    wide = dsl.fc(dsl.data("r2", dense_vector(16)), size=n)
+    c = d = dsl.fc(wide, size=4)
+    return dsl.addto([b, wide]), c, d, extra
+
+
+class _NotTheDsl:
+    @staticmethod
+    def embedding(x, size):
+        return (x, size)
+
+
+def same_name_different_module():
+    # a local `embedding` that is not the dsl's must not match
+    return _NotTheDsl.embedding("dense", size=16)

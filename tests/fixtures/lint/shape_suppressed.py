@@ -1,0 +1,18 @@
+"""PT-SHAPE fixture: deliberate contradictions under justified pragmas."""
+from paddle_tpu.config import dsl
+from paddle_tpu.data.feeder import dense_vector, integer_value
+
+
+def padded_label_space():
+    x = dsl.data("x", dense_vector(8))
+    pred = dsl.fc(x, size=10, act=None)
+    lab = dsl.data("label", integer_value(2))
+    # ptpu: lint-ok[PT-SHAPE] label space padded to 10 at feed time
+    return dsl.classification_cost(pred, lab)
+
+
+def planar_reinterpret():
+    img = dsl.data("image", dense_vector(3 * 16 * 16))
+    conv = dsl.img_conv(img, filter_size=3, num_filters=8,  # ptpu: lint-ok[PT-SHAPE] reinterpret cast upstream
+                        num_channels=4, padding=1)
+    return conv

@@ -1,0 +1,38 @@
+"""PT-SHAPE fixture: literal DSL configs with provable contradictions.
+
+Every violating layer is line-pinned by tests/test_static_analysis.py.
+"""
+from paddle_tpu.config import dsl
+from paddle_tpu.data.feeder import dense_vector, integer_value
+
+
+def wrong_conv_channels():
+    img = dsl.data("image", dense_vector(3 * 16 * 16))
+    conv = dsl.img_conv(img, filter_size=3, num_filters=8,   # line 11:
+                        num_channels=4, padding=1)           # 4ch != 768
+    return conv
+
+
+def class_count_mismatch():
+    x = dsl.data("x", dense_vector(8))
+    pred = dsl.fc(x, size=10, act=None)
+    lab = dsl.data("label", integer_value(2))
+    return dsl.classification_cost(pred, lab)                # 10 vs 2
+
+
+def float_label():
+    x = dsl.data("x", dense_vector(8))
+    pred = dsl.fc(x, size=4, act=None)
+    bad = dsl.data("target", dense_vector(4))
+    return dsl.classification_cost(pred, bad)                # dense label
+
+
+def embedding_over_dense():
+    x = dsl.data("feat", dense_vector(8))
+    return dsl.embedding(x, size=16)                         # not ids
+
+
+def addto_width_mismatch():
+    a = dsl.data("a", dense_vector(8))
+    b = dsl.data("b", dense_vector(6))
+    return dsl.addto([a, b])                                 # 8 vs 6

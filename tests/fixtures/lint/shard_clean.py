@@ -1,0 +1,43 @@
+"""PT-SHARD fixture: tables that must NOT be flagged.
+
+Valid regexes, distinct patterns (overlap resolved by documented
+first-match priority is legal — the runtime verifier warns, the lint
+rule stays quiet), tuple axes, and non-literal entries that the
+extractor must skip rather than guess about.  Plus an unrelated
+``.add(str, ...)`` call that must not be mistaken for a rule table.
+"""
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import ShardingRules
+
+_FC_PATTERN = r"\.w\d*$"
+
+
+def priority_table():
+    return ShardingRules([
+        (r"emb|__table|lookup", P("model", None)),
+        (r"\.wbias$|\.b$|bn|batch_norm", P()),
+        (r"lstm|gru|recurrent", P()),
+        (r"\.w\d*$", P(None, "model")),
+        (r"big", P((("data", "model")), None)),   # tuple axes are legal
+    ])
+
+
+def dynamic_entries(pattern):
+    rules = ShardingRules([(pattern, P())])       # non-literal: skipped
+    rules.add(_FC_PATTERN, P(None, "model"))      # named const: skipped
+    return rules
+
+
+class _Registry:
+    def __init__(self):
+        self.items = {}
+
+    def add(self, key, value):
+        self.items[key] = value
+
+
+def unrelated_add():
+    r = _Registry()
+    r.add("emb(", 1)          # not a rule table: second arg is not a P
+    return r

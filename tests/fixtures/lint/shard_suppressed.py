@@ -1,0 +1,12 @@
+"""PT-SHARD fixture: a deliberate shadow under a justified pragma."""
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import ShardingRules
+
+
+def staged_migration():
+    return ShardingRules([
+        (r"\.w\d*$", P(None, "model")),
+        # ptpu: lint-ok[PT-SHARD] staged rollout: old rule kept for diff
+        (r"\.w\d*$", P("data", None)),
+    ])

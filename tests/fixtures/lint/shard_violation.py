@@ -1,0 +1,26 @@
+"""PT-SHARD fixture: broken literal ShardingRules tables, line-pinned."""
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import ShardingRules
+
+
+def broken_table():
+    return ShardingRules([
+        (r"emb(", P("model", None)),            # line 9: bad regex
+        (r"\.w\d*$", P(None, "model")),
+        (r"\.w\d*$", P("data", None)),          # line 11: shadowed
+        (r"\.wbias$", P(0)),                    # line 12: int axis
+    ])
+
+
+def shadowed_duplicate_spec():
+    return ShardingRules([
+        (r"lstm", P()),
+        (r"lstm", P()),                         # line 19: dead dup
+    ])
+
+
+def bad_add_call():
+    rules = ShardingRules([])
+    rules.add(r"att[", P(None, "model"))        # line 25: bad regex
+    return rules

@@ -1,0 +1,295 @@
+"""ptpu-verify runtime half (`paddle_tpu/analysis/netcheck.py`).
+
+Three contracts, mirroring ISSUE 14's acceptance criteria:
+
+1. **PT-SHAPE core**: the abstract interpreter verifies the real model
+   zoo clean, reports planted contradictions with full layer-path
+   provenance, and its static conv→BN fused-pair census equals the
+   runtime ``network_conv_bn_fused_pairs`` gauge on ResNet-50 (by
+   construction: ``NeuralNetwork`` builds its peephole tables from
+   ``netcheck.fusion_plan`` — this pins that they can never drift).
+2. **PT-SHARD core**: ``check_sharding`` flags unmatched and ambiguous
+   parameters, rank-excluded rules, unknown mesh axes, and
+   mesh-indivisible dims — per topology, in milliseconds.
+3. **Preflight**: a mesh-indivisible rule fails ``dryrun_multichip``
+   in under a second, before anything compiles.
+"""
+
+import re
+import time
+
+import pytest
+
+from paddle_tpu.analysis import netcheck
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.data.feeder import dense_vector, integer_value
+from paddle_tpu.models.image import resnet
+from paddle_tpu.models.text import (lstm_text_classifier,
+                                    transformer_text_classifier)
+
+
+def _resnet50_cfg():
+    with config_scope():
+        img = dsl.data("image", dense_vector(3 * 224 * 224),
+                       height=224, width=224)
+        lab = dsl.data("label", integer_value(1000))
+        probs = resnet(img, depth=50, num_classes=1000)
+        cost = dsl.classification_cost(probs, lab)
+        return dsl.topology(cost)
+
+
+# ================================================== PT-SHAPE: interpreter
+def test_model_zoo_verifies_clean():
+    for cfg in (_resnet50_cfg(),
+                lstm_text_classifier(vocab_size=1000, embed_dim=16,
+                                     hidden_size=32, lstm_num=2),
+                transformer_text_classifier(
+                    vocab_size=1000, model_dim=16, num_heads=2,
+                    num_layers=1, ffn_dim=32, max_len=16)):
+        issues = netcheck.check_model(cfg)
+        assert issues == [], [i.render() for i in issues]
+
+
+def test_conv_channel_mismatch_with_provenance():
+    with config_scope():
+        img = dsl.data("image", dense_vector(3 * 16 * 16))
+        conv = dsl.img_conv(img, filter_size=3, num_filters=8,
+                            num_channels=4, padding=1)
+        pred = dsl.fc(conv, size=2, act=dsl.SoftmaxActivation())
+        cost = dsl.classification_cost(
+            pred, dsl.data("label", integer_value(2)))
+        cfg = dsl.topology(cost)
+    errs = netcheck.errors(netcheck.check_model(cfg))
+    assert len(errs) == 1
+    e = errs[0]
+    assert e.kind == "shape" and "wrong num_channels" in e.message
+    # full layer-path provenance: data layer -> the offending conv
+    assert e.path[0] == "image" and e.path[-1] == e.where
+
+
+def test_class_cost_and_dtype_mismatches():
+    with config_scope():
+        x = dsl.data("x", dense_vector(8))
+        emb = dsl.embedding(x, size=4)              # dense ids: dtype
+        pred = dsl.fc(emb, size=10,
+                      act=dsl.SoftmaxActivation())  # 10 classes
+        lab = dsl.data("label", integer_value(2))   # 2 classes
+        cfg = dsl.topology(dsl.classification_cost(pred, lab))
+    issues = netcheck.check_model(cfg)
+    kinds = sorted(i.kind for i in issues)
+    assert kinds == ["dtype", "shape"]
+    assert any("class probabilities" in i.message for i in issues)
+    assert any("non-integer input" in i.message for i in issues)
+
+
+def test_transposed_conv_is_opaque_to_the_conv_check():
+    """`exconvt` output geometry is the TRANSPOSE formula — the
+    forward-conv check must not judge it (regression: a correctly
+    sized deconv was reported as a fatal shape error)."""
+    with config_scope():
+        img = dsl.data("z", dense_vector(4 * 4 * 4))
+        up = dsl.img_conv(img, filter_size=3, num_filters=8,
+                          num_channels=4, stride=2, padding=0,
+                          trans=True, name="up")
+        cfg = dsl.topology(dsl.square_error_cost(
+            dsl.fc(up, size=8), dsl.data("t", dense_vector(8))))
+    # whatever size the dsl declared, the verifier stays silent on the
+    # transposed conv itself
+    assert [i for i in netcheck.check_model(cfg)
+            if i.where == "up"] == []
+
+
+def test_policy_resolved_dtype_names_in_reports():
+    """Float values propagate as the POLICY output dtype name — a
+    bf16-activations report says bfloat16 where it means it."""
+    with config_scope():
+        x = dsl.data("x", dense_vector(8))
+        cfg = dsl.topology(dsl.classification_cost(
+            dsl.fc(dsl.embedding(x, size=4), size=2, act=None),
+            dsl.data("label", integer_value(2))))
+    issues = netcheck.check_model(cfg, policy=("bfloat16", "bfloat16"))
+    emb = next(i for i in issues if i.kind == "dtype")
+    assert "bfloat16" in emb.message
+    fp32 = netcheck.check_model(cfg)
+    assert any("float32" in i.message for i in fp32
+               if i.kind == "dtype")
+
+
+def test_verify_method_on_network():
+    from paddle_tpu.layers.network import NeuralNetwork
+
+    net = NeuralNetwork(lstm_text_classifier(
+        vocab_size=500, embed_dim=8, hidden_size=16, lstm_num=1))
+    assert net.verify() == []
+
+
+# =================================================== fused-pair census
+def test_static_census_equals_runtime_census_resnet50():
+    """Acceptance pin: the STATIC census (no jax, no build) equals the
+    runtime ``network_conv_bn_fused_pairs`` gauge after the real
+    network build — 16 fwd 3×3 + 16 fwd 1×1, bwd evicted — because
+    network.py builds its peephole from netcheck.fusion_plan."""
+    from paddle_tpu.layers.network import NeuralNetwork
+    from paddle_tpu.observe import REGISTRY
+
+    cfg = _resnet50_cfg()
+    census = netcheck.fused_pair_census(cfg)
+    assert census == {"bwd_3x3": 0, "fwd_3x3": 16, "fwd_1x1": 16}
+
+    net = NeuralNetwork(cfg)
+    g = REGISTRY.gauge("network_conv_bn_fused_pairs")
+    assert census["bwd_3x3"] == g.value(direction="bwd", kernel="3x3") \
+        == len(net._conv_bn_fuse)
+    assert census["fwd_3x3"] == g.value(direction="fwd", kernel="3x3")
+    assert census["fwd_1x1"] == g.value(direction="fwd", kernel="1x1")
+    assert census["fwd_3x3"] + census["fwd_1x1"] \
+        == len(net._bn_conv_fuse)
+
+
+def test_fusion_plan_kill_switch_parity():
+    cfg = _resnet50_cfg()
+    bwd, fwd = netcheck.fusion_plan(cfg, fuse_fwd=False)
+    assert fwd == {} and len(bwd) == 16     # the round-6 resolution
+    bwd2, fwd2 = netcheck.fusion_plan(cfg, fuse_bwd=False,
+                                      fuse_fwd=False)
+    assert bwd2 == {} and fwd2 == {}
+
+
+# ==================================================== PT-SHARD: verifier
+def _table(*rules):
+    return [(re.compile(p), s) for p, s in rules]
+
+
+class _P(tuple):
+    """PartitionSpec stand-in (tuple duck-type) — keeps this suite off
+    the jax import for the pure-verifier cases."""
+
+    def __new__(cls, *entries):
+        return super().__new__(cls, entries)
+
+
+def test_sharding_unmatched_and_ambiguous_flagged():
+    table = _table((r"emb", _P("model", None)),
+                   (r"\.w\d$", _P(None, "model")))
+    dims = {"_emb.w0": [64, 16],        # matches BOTH, different specs
+            "_fc.w0": [16, 8],          # matches #1 only
+            "_odd.bias": [8]}           # matches nothing
+    issues = netcheck.check_sharding(
+        table, dims, {"data": 2, "model": 2})
+    msgs = {i.where: i for i in issues}
+    amb = msgs["_emb.w0"]
+    assert amb.severity == "warn" and "ambiguous" in amb.message
+    assert "first-match-wins" in amb.message
+    unmatched = msgs["_odd.bias"]
+    assert unmatched.severity == "warn" \
+        and "NO sharding rule" in unmatched.message
+    # strict mode escalates unmatched to an error
+    strict = netcheck.check_sharding(
+        table, dims, {"data": 2, "model": 2}, strict=True)
+    assert any(i.where == "_odd.bias" and i.severity == "error"
+               for i in strict)
+
+
+def test_sharding_mesh_divisibility_and_unknown_axis():
+    table = _table((r"\.w0$", _P(None, "model")),
+                   (r"\.ghost$", _P("nosuch")))
+    issues = netcheck.check_sharding(
+        table, {"_fc.w0": [16, 6], "_x.ghost": [8]},
+        {"data": 2, "model": 4})
+    errs = netcheck.errors(issues)
+    assert any("not divisible" in e.message and e.where == "_fc.w0"
+               for e in errs)           # 6 % 4 != 0
+    assert any("does not exist" in e.message and e.where == "_x.ghost"
+               for e in errs)
+    # the same table on a divisible topology has no errors
+    ok = netcheck.check_sharding(
+        table, {"_fc.w0": [16, 8]}, {"data": 4, "model": 2})
+    assert netcheck.errors(ok) == []
+
+
+def test_sharding_rank_exclusion_semantics():
+    table = _table((r"\.wbias$", _P(None, "model")),   # rank 2 spec
+                   (r".*", _P()))
+    issues = netcheck.check_sharding(
+        table, {"_fc.wbias": [8]}, {"data": 2, "model": 2})
+    # the higher-priority match is rank-excluded; resolution falls
+    # through to the catch-all — surprise worth a warning, not fatal
+    assert netcheck.errors(issues) == []
+    assert any("rank-excluded" in i.message for i in issues)
+    # a table where EVERY matching rule is rank-excluded is an error
+    only = _table((r".*", _P(None, "model")))
+    bad = netcheck.check_sharding(only, {"_fc.wbias": [8]},
+                                  {"data": 2, "model": 2})
+    assert any(e.severity == "error" and "rank" in e.message
+               for e in bad)
+
+
+def test_sharding_rules_verify_and_preflight_raise():
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel import (ShardingRules, param_dims_of,
+                                     verify_rules_or_raise)
+    from paddle_tpu.utils import PaddleTpuError
+
+    rules = ShardingRules([(r"\.w\d*$", P(None, "model"))])
+    dims = {"_fc.w0": [16, 6]}
+    issues = rules.verify(dims, {"data": 2, "model": 4})
+    assert any("not divisible" in i.message
+               for i in netcheck.errors(issues))
+    with pytest.raises(PaddleTpuError, match="preflight"):
+        verify_rules_or_raise(rules, dims, {"data": 2, "model": 4})
+    # clean on the divisible topology
+    verify_rules_or_raise(rules, {"_fc.w0": [16, 8]},
+                          {"data": 2, "model": 2})
+
+    from paddle_tpu.layers.network import NeuralNetwork
+    net = NeuralNetwork(lstm_text_classifier(
+        vocab_size=500, embed_dim=8, hidden_size=16, lstm_num=1))
+    pd = param_dims_of(net)
+    assert pd["___embedding_1__.w0"] == [500, 8]
+    assert all(isinstance(v, list) for v in pd.values())
+
+
+def test_tp_rules_verify_clean_on_dryrun_topologies():
+    """The repo's own default table must keep its zero-error contract
+    on every mesh the driver's dryrun compiles."""
+    from paddle_tpu.layers.network import NeuralNetwork
+    from paddle_tpu.parallel import param_dims_of, tp_rules
+
+    net = NeuralNetwork(lstm_text_classifier(
+        vocab_size=1000, embed_dim=16, hidden_size=32, lstm_num=2))
+    dims = param_dims_of(net)
+    for axes in ({"data": 1, "model": 1}, {"data": 2, "model": 2},
+                 {"data": 4, "model": 2}, {"data": 8, "model": 1}):
+        issues = tp_rules().verify(dims, axes)
+        assert netcheck.errors(issues) == [], \
+            [i.render() for i in issues]
+
+
+# ========================================================== preflight
+def test_dryrun_preflight_fails_fast_without_compiling():
+    """Acceptance pin: a mesh-indivisible sharding rule fails the
+    dryrun preflight in <1 s — before any topology compiles."""
+    from jax.sharding import PartitionSpec as P
+
+    from __graft_entry__ import dryrun_multichip
+    from paddle_tpu.core import device
+    from paddle_tpu.parallel import ShardingRules
+    from paddle_tpu.utils import PaddleTpuError
+
+    # on dryrun(4)'s data:2×model:2 mesh the fc head's [32, 2] weight
+    # cannot shard its 2-wide output over the 4-way data×model product
+    # — only a verifier (or a pod compile) can know that
+    bad = ShardingRules([(r"\.w\d*$", P(None, ("data", "model")))])
+    old_mesh = device._mesh
+    t0 = time.perf_counter()
+    try:
+        with pytest.raises(PaddleTpuError) as ei:
+            dryrun_multichip(4, sharding_rules=bad)
+        elapsed = time.perf_counter() - t0
+    finally:
+        device.set_mesh(old_mesh)
+    assert "preflight" in str(ei.value)
+    assert "not divisible" in str(ei.value)
+    assert elapsed < 1.0, f"preflight took {elapsed:.2f}s"

@@ -175,6 +175,77 @@ def test_metric_fixture_suppressed_and_clean():
     assert _run_one("metric_clean.py", rules=["PT-METRIC"]).findings == []
 
 
+def test_shape_fixture_catches_every_mismatch_class():
+    res = _run_one("shape_violation.py", rules=["PT-SHAPE"])
+    assert all(f.rule == "PT-SHAPE" for f in res.findings)
+    # wrong conv num_channels, class-count mismatch, float label,
+    # embedding over dense, addto width disagreement — one each
+    assert _lines(res, "PT-SHAPE") == [11, 20, 27, 32, 38]
+    by_line = {f.line: f.message for f in res.findings}
+    assert "wrong num_channels" in by_line[11]
+    assert "10 class probabilities" in by_line[20] \
+        and "2 classes" in by_line[20]
+    assert "integer class-id label" in by_line[27]
+    assert "embedding lookup over a non-integer input" in by_line[32]
+    assert "addto inputs disagree" in by_line[38]
+    # full layer-path provenance rides along on graph findings
+    assert "[layer path:" in by_line[20]
+
+
+def test_shape_fixture_suppressed_and_clean():
+    sup = _run_one("shape_suppressed.py", rules=["PT-SHAPE"])
+    assert not sup.findings and len(sup.suppressed) == 2
+    assert _run_one("shape_clean.py", rules=["PT-SHAPE"]).findings == []
+
+
+def test_shard_fixture_catches_every_table_breakage():
+    res = _run_one("shard_violation.py", rules=["PT-SHARD"])
+    assert _lines(res, "PT-SHARD") == [9, 11, 12, 19, 25]
+    by_line = {f.line: f.message for f in res.findings}
+    assert "does not compile" in by_line[9]
+    assert "silently shadowed" in by_line[11]
+    assert "not a mesh-axis NAME" in by_line[12]
+    assert "dead" in by_line[19]
+    assert "does not compile" in by_line[25]
+
+
+def test_shard_fixture_suppressed_and_clean():
+    sup = _run_one("shard_suppressed.py", rules=["PT-SHARD"])
+    assert not sup.findings and len(sup.suppressed) == 1
+    assert _run_one("shard_clean.py", rules=["PT-SHARD"]).findings == []
+
+
+def test_race_fixture_catches_every_sharing_class():
+    res = _run_one("race_violation.py", rules=["PT-RACE"])
+    # unguarded counter write, unguarded module-global mutation,
+    # one-side-only lock — anchored at the racy write
+    assert _lines(res, "PT-RACE") == [26, 27, 34]
+    by_line = {f.line: f.message for f in res.findings}
+    assert "Collector.total" in by_line[26]
+    assert "no common named_lock guard" in by_line[26]
+    assert "_seen" in by_line[27] and "module global" in by_line[27]
+    assert "Collector.latest" in by_line[34]
+    # the pooled comprehension entrypoint is named as a witness
+    assert "ptpu-fix-p" in by_line[26]
+
+
+def test_race_fixture_suppressed_and_clean():
+    sup = _run_one("race_suppressed.py", rules=["PT-RACE"])
+    assert not sup.findings and len(sup.suppressed) == 1
+    assert _run_one("race_clean.py", rules=["PT-RACE"]).findings == []
+
+
+def test_race_entrypoint_discovery_on_fixture():
+    from paddle_tpu.analysis import racecheck
+
+    project, _ = engine.build_project([_fx("race_violation.py")])
+    entries = {e.label(): e.pooled
+               for e in racecheck.find_entrypoints(project)}
+    assert any("_worker [ptpu-fix-w]" in k for k in entries)
+    # the comprehension-constructed pool is marked concurrent-with-self
+    assert any("ptpu-fix-p" in k and entries[k] for k in entries)
+
+
 def test_lock_graph_builds_named_edges():
     project, _ = engine.build_project([_fx("lock_clean.py")])
     graph, findings = lock_order.build_lock_graph(project)
@@ -376,15 +447,94 @@ def test_cli_lock_graph_dump(capsys):
     assert "acyclic" in out
 
 
+def test_cli_list_rules(capsys):
+    """--list-rules prints every rule id with its one-line doc."""
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in engine.RULE_CODES:
+        assert code in out
+    assert "shape/dtype" in out and "named_lock guard" in out
+
+
+def test_cli_unknown_rule_names_the_valid_set(capsys):
+    """A typo'd --rules errors (exit 2) and prints the valid choices
+    instead of silently matching nothing."""
+    assert lint_main([FIXTURES, "--rules", "PT-SHAPES"]) == 2
+    err = capsys.readouterr().err
+    assert "PT-SHAPES" in err and "PT-SHAPE" in err \
+        and "PT-RACE" in err
+
+
+def test_cli_exit_codes_for_verify_rules(capsys):
+    """The 0/1/2 contract covers the three ptpu-verify rules."""
+    assert lint_main([_fx("shape_clean.py"),
+                      "--rules", "PT-SHAPE"]) == 0
+    assert lint_main([_fx("shape_violation.py"),
+                      "--rules", "PT-SHAPE"]) == 1
+    assert lint_main([_fx("shard_violation.py"),
+                      "--rules", "PT-SHARD"]) == 1
+    assert lint_main([_fx("race_violation.py"),
+                      "--rules", "PT-RACE"]) == 1
+    out = capsys.readouterr().out
+    assert "PT-SHAPE" in out and "PT-SHARD" in out \
+        and "PT-RACE" in out
+    assert lint_main([_fx("race_clean.py"), "--rules",
+                      "PT-SHAPE,PT-SHARD,PT-RACE"]) == 0
+
+
 # ======================================================== the repo gate
 def test_repo_lints_clean():
     """THE tier-1 gate: zero non-suppressed findings over paddle_tpu/.
     A finding here means a new hazard (fix it) or a deliberate site
-    (pragma it with a justification) — never ignore it."""
+    (pragma it with a justification) — never ignore it.  The default
+    rule set MUST include the ptpu-verify rules (PT-SHAPE / PT-SHARD /
+    PT-RACE), so this one test extends the zero-findings contract to
+    them as the rule count grows."""
+    assert {"PT-SHAPE", "PT-SHARD", "PT-RACE"} <= set(engine.RULE_CODES)
+    assert set(ALL_RULES) == set(engine.RULE_CODES)
     res = engine.run([PKG_DIR])
     assert res.files > 100      # the walker actually saw the package
     rendered = "\n".join(f.render() for f in res.findings)
     assert not res.findings, f"ptpu-lint findings:\n{rendered}"
+
+
+def test_repo_race_entrypoints_cover_the_thread_fleet():
+    """PT-RACE's sweep is only as good as its entrypoint discovery:
+    the known framework threads (pipeline workers, reader pool, trace
+    writer, metrics reporter, SIGTERM flusher, debug dump, master
+    read-ahead, the two HTTP handler families) must all resolve."""
+    from paddle_tpu.analysis import racecheck
+
+    project, _ = engine.build_project([PKG_DIR])
+    labels = {e.label() for e in racecheck.find_entrypoints(project)}
+    text = " | ".join(sorted(labels))
+    for needle in ("AsyncPipeline._worker", "ptpu-trace-writer",
+                   "ptpu-metrics-reporter", "ptpu-sigterm-flush",
+                   "ptpu-debug-dump", "fetcher", "http:_Handler",
+                   "http:_FleetHandler"):
+        assert needle in text, f"missing entrypoint {needle}: {text}"
+    assert len(labels) >= 10
+
+
+def test_parse_cache_single_parse_property():
+    """The engine speedup satellite's pin: one ast.parse per file
+    CONTENT — a second sweep over the same tree re-parses nothing
+    (rules already share one Project per run; the content-hash cache
+    shares it across runs too)."""
+    from paddle_tpu.analysis import callgraph
+
+    callgraph.clear_parse_cache()
+    engine._PRAGMA_CACHE.clear()
+    res1 = engine.run([FIXTURES])
+    parses_after_first = callgraph.parse_stats["parses"]
+    assert parses_after_first >= res1.files
+    res2 = engine.run([FIXTURES])
+    assert res2.files == res1.files
+    assert callgraph.parse_stats["parses"] == parses_after_first, \
+        "second sweep re-parsed files the cache should have served"
+    assert callgraph.parse_stats["cache_hits"] >= res1.files
+    # pragma tables are cached by the same content hash
+    assert len(engine._PRAGMA_CACHE) > 0
 
 
 def test_repo_lock_graph_is_current():
