@@ -19,6 +19,12 @@ Six workloads (the first printed line is the driver-parsed metric):
 4. **transformer** training tokens/sec at T=2048 — the flash-attention
    kernel's product surface (``scaled_dot_product_attention`` layer);
    no reference yardstick exists (2017 codebase), MFU is the figure.
+   Round 19 rebuilt this into an A/B lane: a causal-T=2048 row (dense
+   XLA [small scale only] vs the legacy fetch-every-block grid vs
+   block-sparse, each stamping ms/batch, tokens/sec, MFU and the
+   attributed attention-region HBM bytes), a padded-vs-packed
+   mixed-length row, and a paged-KV decode microbench row
+   (``--attention_small`` for CPU shapes).  See :func:`bench_attention`.
 5. **LSTM hidden=1280** ms/batch — the baseline's big-hidden row
    (1007 ms on K40m, ``benchmark/README.md:124-126``).  Round 8's
    hidden-blocked tier (``ops/pallas_lstm.py``) carries this row on
@@ -538,49 +544,230 @@ def bench_seq2seq():
         hint_flops=TRAIN_FLOP_FACTOR * (enc + dec))
 
 
-def bench_attention():
-    """Transformer encoder training tokens/sec at long context (T=2048)
-    — the product surface of the Pallas flash-attention kernel
-    (``ops/pallas_attention.py`` via the ``scaled_dot_product_attention``
-    layer).  The reference predates transformers, so like seq2seq there
-    is no published yardstick; MFU is the comparable figure."""
-    FLAGS.set("bf16_activations", True)
-    from paddle_tpu.core.sequence import SequenceBatch
-    from paddle_tpu.models import transformer_text_classifier
+# --attention_small: CPU-runnable shapes for the attention A/B lane
+ATTENTION_SMALL = False
 
+
+def _attention_shapes():
+    """(B, T, D, HEADS, L, F, V) for the attention lane."""
+    if ATTENTION_SMALL:
+        return 2, 512, 128, 4, 2, 256, 2000
     # B swept with the Pallas backward: 8 → 432k, 16 → 463k (best),
     # 32 → 427k tokens/s (pre-Pallas-backward, B=16 lost to B=8 —
     # the dense einsum backward's HBM pressure)
-    B, T, D, HEADS, L, F, V = 16, 2048, 512, 8, 4, 2048, 30000
+    return 16, 2048, 512, 8, 4, 2048, 30000
+
+
+def _attention_workload(causal=False, packed=False, mixed_lengths=False,
+                        seed=0):
+    """Build one transformer trainer + feed for the attention lane.
+    ``mixed_lengths`` draws ragged valid lengths in [T/4, T] (the
+    padded/packed A/B input); returns (trainer, feed, analytic fwd
+    FLOPs, valid-token count)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer_text_classifier
+
+    B, T, D, HEADS, L, F, V = _attention_shapes()
+    # block = T/4 at small scale so the causal grid is 4×4 there too —
+    # the skip fraction under measure (10/16 live pairs) matches the
+    # bench-scale T=2048 row's, just narrower
+    blk = 128 if ATTENTION_SMALL else 512
     cfg = transformer_text_classifier(
         vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
-        ffn_dim=F, num_classes=2, max_len=T)
+        ffn_dim=F, num_classes=2, max_len=T, causal=causal,
+        packed=packed, block_q=blk, block_k=blk)
     trainer = _mk_trainer(cfg, lr=1e-3)
-
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(seed)
+    if mixed_lengths:
+        lengths = rng.randint(T // 4, T + 1, (B,)).astype(np.int32)
+    else:
+        lengths = np.full((B,), T, np.int32)
     feed = {"data": SequenceBatch(
                 jax.numpy.asarray(rng.randint(0, V, (B, T)).astype(np.int32)),
-                jax.numpy.asarray(np.full((B,), T, np.int32))),
+                jax.numpy.asarray(lengths)),
             "label": jax.numpy.asarray(rng.randint(0, 2, (B,)).astype(np.int32))}
-
-    ms, agree = _scan_time_ms(trainer, feed, iters=32)
-    n = _n_chips(trainer)
-    tokens_per_sec = B * T / (ms / 1e3)
     # analytic fwd MACs/layer (MFU fallback — the flash-attention
     # Pallas kernel hides its FLOPs from XLA): qkv B·T·D·3D + scores
     # B·T²·D + p·v B·T²·D + out-proj B·T·D·D + ffn B·T·2·D·F
     fwd = 2 * L * B * T * (3 * D * D + 2 * T * D + D * D + 2 * D * F)
-    return _finish(_with_band({
-        "metric": "transformer_tokens_per_sec",
-        "value": round(tokens_per_sec, 0),
-        "unit": f"tokens/sec (bs={B}, T={T}, d={D}, {L}L/{HEADS}H, "
-                "flash attention)",
-        "vs_baseline_note": "reference predates transformers; no "
-                            "published number",
-        "devices": n,
-        "timing_self_check": round(agree, 3),
-    }), "attention", trainer, feed, step_ms=ms,
-        hint_flops=TRAIN_FLOP_FACTOR * fwd)
+    return trainer, feed, fwd, int(lengths.sum())
+
+
+def _attn_region_bytes(report):
+    """Attributed HBM bytes of the attention regions (attn0..attnL-1)
+    of one cost report — the per-mode number the block-sparse A/B
+    exists to move (and --attribution_diff --check pins)."""
+    if not report:
+        return None
+    return round(sum(r["bytes"] for r in report.get("regions", ())
+                     if r["region"].startswith("attn")), 1)
+
+
+def _attention_mode_flags(mode):
+    """Flag combo per A/B mode — same vocabulary as the
+    ``attention_dispatch_total{path}`` counter."""
+    return {
+        "dense": {"flash_kernel": False, "flash_block_sparse": True},
+        "legacy": {"flash_kernel": True, "flash_block_sparse": False},
+        "block_skip": {"flash_kernel": True, "flash_block_sparse": True},
+    }[mode]
+
+
+def _attention_ab_row(workload, modes, builds, iters, tokens_of):
+    """Time one workload under each mode's flag combo; every mode entry
+    carries ms/batch, tokens/sec, the shared-implementation MFU and the
+    attributed attention-region HBM bytes."""
+    row = {"workload": workload}
+    for mode in modes:
+        for flag, val in _attention_mode_flags(mode).items():
+            FLAGS.set(flag, val)
+        trainer, feed, fwd, tokens = builds()
+        ms, agree = _scan_time_ms(trainer, feed, iters=iters)
+        n = _n_chips(trainer)
+        hint = TRAIN_FLOP_FACTOR * fwd
+        tag = f"attention-{workload}-{mode}"
+        mfu = costmodel.step_mfu(trainer, feed, ms / 1e3, devices=n,
+                                 fallback_flops=hint, cache_key=tag)
+        report = costmodel.analyze_trainer_step(trainer, feed,
+                                                cache_key=tag)
+        row[mode] = {
+            "ms_per_batch": round(ms, 3),
+            "tokens_per_sec": round(tokens_of(tokens) / (ms / 1e3), 0),
+            "timing_self_check": round(agree, 3),
+            "attn_region_bytes": _attn_region_bytes(report),
+            **{k: mfu[k] for k in ("mfu_est", "mfu_source")},
+        }
+        del trainer
+        jax.clear_caches()
+    return row
+
+
+def _attention_decode_row():
+    """Decode-shape microbench: the paged-KV decode primitive
+    (``ops/pallas_attention.paged_decode_attention``) over a
+    partially-filled cache — ms/decode-call and queries/sec, the
+    numbers ROADMAP item 1's serving loop will inherit."""
+    from paddle_tpu.ops.pallas_attention import paged_decode_attention
+
+    if ATTENTION_SMALL:
+        B, H, D, page, n_max, P, calls = 8, 4, 32, 64, 4, 64, 20
+    else:
+        B, H, D, page, n_max, P, calls = 64, 8, 64, 128, 16, 1024, 50
+    rng = np.random.RandomState(0)
+    kpg = jax.numpy.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    vpg = jax.numpy.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    pidx = jax.numpy.asarray(
+        rng.randint(0, P, (B, n_max)).astype(np.int32))
+    lengths_np = rng.randint(page, page * n_max + 1, (B,))
+    lengths = jax.numpy.asarray(lengths_np.astype(np.int32))
+    q = jax.numpy.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+    step = jax.jit(paged_decode_attention)
+    step(q, kpg, vpg, pidx, lengths).block_until_ready()   # compile
+    times = []
+    for _ in range(calls):
+        t0 = time.perf_counter()
+        step(q, kpg, vpg, pidx, lengths).block_until_ready()
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = float(np.median(times))
+    return {
+        "workload": "decode_paged",
+        "decode": {"ms_per_call": round(ms, 3)},
+        "queries_per_sec": round(B / (ms / 1e3), 1),
+        "kv_tokens": int(lengths_np.sum()),
+        "shape": {"batch": B, "heads": H, "head_dim": D,
+                  "page_size": page, "pages_per_row": n_max,
+                  "pool_pages": P},
+    }
+
+
+def bench_attention():
+    """Attention lane (`--only attention`, reworked round 19):
+
+    - headline: transformer encoder training tokens/sec at long context
+      (T=2048) on the DEFAULT path (block-sparse flash) — the metric the
+      previous rounds carried, so the trajectory stays comparable;
+    - ``causal_t2048`` A/B row: dense XLA (small scale only — the [T,T]
+      scores don't fit at bench scale, which is the point of flash) vs
+      the legacy fetch-every-block grid vs block-skip, each stamping
+      ms/batch, tokens/sec, MFU and the attributed attention-region HBM
+      bytes — the same number the committed roofline dumps pin via
+      ``--attribution_diff --check``;
+    - ``padded_mixed`` A/B row: ragged lengths in [T/4, T], padded
+      per-row lowering vs sequence packing (``packed=True`` layer attr;
+      tokens/sec counts VALID tokens only);
+    - ``decode_paged`` row: the paged-KV decode primitive microbench.
+
+    The reference predates transformers, so like seq2seq there is no
+    published yardstick; MFU is the comparable figure."""
+    saved = {k: FLAGS.get(k) for k in
+             ("flash_kernel", "flash_block_sparse", "attention_packing",
+              "bf16_activations")}
+    FLAGS.set("bf16_activations", True)
+    iters = 8 if ATTENTION_SMALL else 32
+    try:
+        causal_modes = ["legacy", "block_skip"]
+        if ATTENTION_SMALL:
+            causal_modes.insert(0, "dense")
+        causal_row = _attention_ab_row(
+            "causal_t2048", causal_modes,
+            lambda: _attention_workload(causal=True), iters,
+            tokens_of=lambda tokens: tokens)
+
+        FLAGS.set("flash_kernel", True)
+        FLAGS.set("flash_block_sparse", True)
+        # the packed mode must actually pack: a process-level
+        # --attention_packing=false would silently turn the A/B into
+        # padded-vs-padded (the layer kill switch reverts the attr)
+        FLAGS.set("attention_packing", True)
+        padded_row = {"workload": "padded_mixed"}
+        for mode, packed in (("padded", False), ("packed", True)):
+            trainer, feed, fwd, tokens = _attention_workload(
+                mixed_lengths=True, packed=packed, seed=1)
+            ms, agree = _scan_time_ms(trainer, feed, iters=iters)
+            tag = f"attention-padded_mixed-{mode}"
+            report = costmodel.analyze_trainer_step(trainer, feed,
+                                                    cache_key=tag)
+            padded_row[mode] = {
+                "ms_per_batch": round(ms, 3),
+                "valid_tokens_per_sec": round(tokens / (ms / 1e3), 0),
+                "timing_self_check": round(agree, 3),
+                "attn_region_bytes": _attn_region_bytes(report),
+            }
+            del trainer
+            jax.clear_caches()
+        padded_row["packing_speedup"] = round(
+            padded_row["padded"]["ms_per_batch"]
+            / max(padded_row["packed"]["ms_per_batch"], 1e-9), 3)
+
+        decode_row = _attention_decode_row()
+
+        # ---- headline: the default path at full length (trajectory
+        # metric; re-built so the A/B flag churn can't leak into it)
+        trainer, feed, fwd, tokens = _attention_workload(causal=False)
+        ms, agree = _scan_time_ms(trainer, feed, iters=iters)
+        n = _n_chips(trainer)
+        tokens_per_sec = tokens / (ms / 1e3)
+        B, T, D, HEADS, L, F, V = _attention_shapes()
+        r = _finish(_with_band({
+            "metric": "transformer_tokens_per_sec",
+            "value": round(tokens_per_sec, 0),
+            "unit": f"tokens/sec (bs={B}, T={T}, d={D}, {L}L/{HEADS}H, "
+                    "block-sparse flash attention)",
+            "vs_baseline_note": "reference predates transformers; no "
+                                "published number",
+            "devices": n,
+            "timing_self_check": round(agree, 3),
+            "scale": "small" if ATTENTION_SMALL else "bench",
+            "rows": [causal_row, padded_row, decode_row],
+        }), "attention", trainer, feed, step_ms=ms,
+            hint_flops=TRAIN_FLOP_FACTOR * fwd)
+        r["attn_region_bytes"] = _attn_region_bytes(
+            costmodel.analyze_trainer_step(trainer, feed,
+                                           cache_key="attention"))
+        return r
+    finally:
+        for k, v in saved.items():
+            FLAGS.set(k, v)
 
 
 # --pipeline_small: CPU-runnable shapes for the prefetch A/B lane
@@ -1292,6 +1479,13 @@ def main(argv=None):
                     help="run the fp32/bf16 precision A/B lane at CPU-"
                          "runnable shapes (the JSON line records "
                          "scale='small'); default is bench scale")
+    ap.add_argument("--attention_small", action="store_true",
+                    help="run the attention A/B lane (dense/legacy/"
+                         "block-skip, padded/packed, paged decode) at "
+                         "CPU-runnable shapes (T=512; the JSON line "
+                         "records scale='small'); default is the bench "
+                         "T=2048 scale, where the dense mode is "
+                         "skipped ([T,T] scores do not fit)")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace of a few production "
                          "train steps per workload (see --profile_dir); "
@@ -1359,6 +1553,9 @@ def main(argv=None):
     if args.precision_small:
         global PRECISION_SMALL
         PRECISION_SMALL = True
+    if args.attention_small:
+        global ATTENTION_SMALL
+        ATTENTION_SMALL = True
     if args.attribution_diff:
         # pure-host replay of two committed dumps: no workload runs, no
         # backend touched — the kernel-PR verification loop stays fast
@@ -1411,7 +1608,8 @@ def main(argv=None):
         doc = benchgate.write_baseline(
             args.write_baseline, lines,
             meta={"scale": ("small" if PIPELINE_SMALL
-                            or PRECISION_SMALL else "bench"),
+                            or PRECISION_SMALL
+                            or ATTENTION_SMALL else "bench"),
                   "argv": sys.argv[1:] if argv is None else list(argv)})
         print(f"wrote baseline {args.write_baseline} "
               f"({len(doc['series'])} series)", file=sys.stderr,

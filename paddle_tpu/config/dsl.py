@@ -400,24 +400,33 @@ def scaled_dot_product_attention(input: Input, size: int,
                                  bias_attr=False,
                                  param_attr: Optional[ParamAttr] = None,
                                  layer_attr=None, block_q: int = 512,
-                                 block_k: int = 512) -> LayerOutput:
+                                 block_k: int = 512,
+                                 packed: bool = False) -> LayerOutput:
     """Multi-head attention backed by the Pallas flash-attention kernel
     (``ops/pallas_attention.py``) — the kernel→layer→config wiring the
     reference used for ``hl_lstm``→``LstmLayer``→``lstmemory``.
 
     One input = self-attention; a ``[query, key, value]`` list =
     cross-attention.  Padded keys are masked from the sequence lengths.
+    ``packed=True`` (self-attention only) runs the sequence-packing
+    lowering: the padded batch shares one segment-id token axis and
+    padding does zero work (``--attention_packing=false`` reverts).
     """
     ins = _as_list(input)
     if len(ins) not in (1, 3):
         raise ConfigError(
             "scaled_dot_product_attention takes 1 input (self-attention) "
             f"or 3 (query, key, value), got {len(ins)}")
+    if packed and len(ins) != 1:
+        raise ConfigError(
+            "scaled_dot_product_attention(packed=True) is self-attention "
+            f"only (1 input), got {len(ins)}")
     pas = [param_attr] + [None] * (len(ins) - 1) if param_attr else None
     return _add_layer(name, "scaled_dot_product_attention", size,
                       _mk_inputs(ins, pas), act, bias_attr,
                       attrs={"num_heads": num_heads, "causal": causal,
-                             "block_q": block_q, "block_k": block_k},
+                             "block_q": block_q, "block_k": block_k,
+                             "packed": packed},
                       layer_attr=layer_attr, param_attrs=pas)
 
 

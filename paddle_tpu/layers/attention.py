@@ -21,7 +21,11 @@ import numpy as np
 from ..config.model_config import ParameterConfig
 from ..core.dtypes import current_policy, record_op_precision
 from ..core.sequence import SequenceBatch, like, value_of
-from ..ops.pallas_attention import flash_attention
+from ..ops.pallas_attention import (flash_attention,
+                                    flash_attention_packed,
+                                    packed_tileable,
+                                    record_attention_dispatch,
+                                    segments_from_lengths)
 from ..utils import enforce
 from .base import Layer, register_layer
 
@@ -48,6 +52,16 @@ class MultiHeadAttentionLayer(Layer):
     Padded keys are masked inside the kernel via the scalar-prefetched
     lengths of the key sequence; queries keep their own lengths on the
     output SequenceBatch.
+
+    ``packed=True`` (self-attention only): the padded batch is packed
+    into ONE ``[1, B·T]`` token axis with per-token segment ids derived
+    from the sequence lengths, and attention runs through
+    :func:`flash_attention_packed` — padding and cross-sequence blocks
+    do zero work (block-sparse path: not even DMA).  Padding positions
+    of the output are exact zeros (they were arbitrary garbage on the
+    padded path; both are masked downstream).  The
+    ``--attention_packing=false`` kill switch makes the layer ignore
+    the attr and run the exact padded per-row lowering.
     """
 
     def param_specs(self):
@@ -105,11 +119,58 @@ class MultiHeadAttentionLayer(Layer):
         b, tq = q.shape[0], q.shape[1]
         tk = k.shape[1]
         split = lambda a, t: a.reshape(b, t, heads, dh)
-        out = flash_attention(
-            split(q, tq), split(k, tk), split(v, tk), kv_len,
-            bool(self.conf.attrs.get("causal", False)),
-            int(self.conf.attrs.get("block_q", 512)),
-            int(self.conf.attrs.get("block_k", 512)))
+        causal = bool(self.conf.attrs.get("causal", False))
+        block_q = int(self.conf.attrs.get("block_q", 512))
+        block_k = int(self.conf.attrs.get("block_k", 512))
+        packed = bool(self.conf.attrs.get("packed", False))
+        # packed blocks clamp to the slot width (one row's T) so the
+        # static cross-row compaction stays usable when T < block
+        pbq, pbk = min(block_q, tq), min(block_k, tq)
+        if packed:
+            from ..utils import FLAGS
+            enforce(len(inputs) == 1,
+                    "packed attention requires self-attention "
+                    f"(1 input), layer {self.name} has {len(inputs)}")
+            if not FLAGS.attention_packing:
+                # kill switch: ignore the attr, run the exact padded
+                # per-row lowering below
+                record_attention_dispatch(
+                    "unpacked", "kill_switch:attention_packing")
+                packed = False
+            elif not FLAGS.flash_block_sparse or not FLAGS.flash_kernel:
+                # the packed kernel IS the block-sparse pair grid; with
+                # it (or the flash kernel) disabled, the honest revert
+                # is the padded per-row lowering — the op-level dense
+                # fallback over the flattened [1, B·T] axis would build
+                # an O((B·T)²) score matrix
+                flag = "flash_kernel" if not FLAGS.flash_kernel \
+                    else "flash_block_sparse"
+                record_attention_dispatch(
+                    "unpacked", f"kill_switch:{flag}(packed)")
+                packed = False
+            elif not packed_tileable(b * tq, pbq, pbk):
+                # the flattened axis would miss the Pallas tiling gate
+                # and the op-level dense fallback on [1, B·T] builds an
+                # O((B·T)²) score matrix — the padded per-row lowering
+                # is the honest fallback here too
+                record_attention_dispatch(
+                    "unpacked", "untileable(packed flatten)")
+                packed = False
+        if packed:
+            lengths = kv_len if kv_len is not None \
+                else jnp.full((b,), tq, jnp.int32)
+            seg = segments_from_lengths(lengths, b, tq)
+            pack = lambda a: a.reshape(1, b * tq, heads, dh)
+            # slot = T: rows occupy fixed T-token slots in the flat
+            # layout, so cross-row block pairs are statically dead and
+            # leave the kernel's iteration space entirely (blocks
+            # clamped to the slot width above keep the hint usable)
+            out = flash_attention_packed(
+                pack(q), pack(k), pack(v), seg, causal, pbq, pbk, tq)
+        else:
+            out = flash_attention(
+                split(q, tq), split(k, tk), split(v, tk), kv_len,
+                causal, block_q, block_k)
         out = out.reshape(b, tq, size) \
             @ params[f"_{self.name}.wo"].astype(cd)
         out = out.astype(pol.output_dtype)

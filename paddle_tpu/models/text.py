@@ -19,6 +19,9 @@ def transformer_classifier_cost(vocab_size: int, model_dim: int = 128,
                                 ffn_dim: int = 512, num_classes: int = 2,
                                 max_len: int = 2048,
                                 causal: bool = False,
+                                packed: bool = False,
+                                block_q: int = 512,
+                                block_k: int = 512,
                                 data_name: str = "data"):
     """Build the transformer classifier cost INSIDE an open
     ``config_scope`` — shared by :func:`transformer_text_classifier`
@@ -30,8 +33,9 @@ def transformer_classifier_cost(vocab_size: int, model_dim: int = 128,
     for i in range(num_layers):
         att = dsl.scaled_dot_product_attention(
             dsl.layer_norm(net, name=f"ln{i}a"), size=model_dim,
-            num_heads=num_heads, causal=causal, name=f"attn{i}",
-            bias_attr=True)
+            num_heads=num_heads, causal=causal, packed=packed,
+            block_q=block_q, block_k=block_k,
+            name=f"attn{i}", bias_attr=True)
         net = dsl.addto([net, att], name=f"res{i}a")
         ffn = dsl.fc(dsl.layer_norm(net, name=f"ln{i}f"),
                      size=ffn_dim, act=dsl.Activation("relu"),
@@ -51,7 +55,10 @@ def transformer_text_classifier(vocab_size: int = 30000,
                                 num_layers: int = 2, ffn_dim: int = 512,
                                 num_classes: int = 2,
                                 max_len: int = 2048,
-                                causal: bool = False) -> ModelConfig:
+                                causal: bool = False,
+                                packed: bool = False,
+                                block_q: int = 512,
+                                block_k: int = 512) -> ModelConfig:
     """Pre-LN transformer encoder classifier over the flash-attention
     layer: embedding + position table → N × (LN → multi-head attention →
     residual; LN → ffn → residual) → final LN → masked mean pool → fc
@@ -62,7 +69,8 @@ def transformer_text_classifier(vocab_size: int = 30000,
     with dsl.config_scope():
         return dsl.topology(transformer_classifier_cost(
             vocab_size, model_dim, num_heads, num_layers, ffn_dim,
-            num_classes, max_len, causal))
+            num_classes, max_len, causal, packed,
+            block_q=block_q, block_k=block_k))
 
 
 def lstm_text_classifier(vocab_size: int = 30000, embed_dim: int = 128,

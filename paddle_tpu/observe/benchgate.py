@@ -83,21 +83,24 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
         }
     # composite lanes: nested per-workload timings are where a "2x on
     # one workload" regression actually lives (the headline of the
-    # pipeline lane is a bounded ratio that would never see it)
+    # pipeline lane is a bounded ratio that would never see it).
+    # Modes: pipeline sync/prefetch, precision fp32/bf16, attention
+    # dense/legacy/block-skip + padded/packed + paged decode.
     for row in line.get("rows", ()):
         tag = row.get("workload", "?")
-        for mode in ("sync", "prefetch"):
-            ms = (row.get(mode) or {}).get("ms_per_batch")
-            if ms is not None:
-                out[f"{metric}.{tag}.{mode}_ms"] = {
-                    "value": float(ms), "spread": spread,
-                    "direction": "lower", "unit": "ms/batch"}
-        for prec in ("fp32", "bf16"):
-            ms = (row.get(prec) or {}).get("ms_per_batch")
-            if ms is not None:
-                out[f"{metric}.{tag}.{prec}_ms"] = {
-                    "value": float(ms), "spread": spread,
-                    "direction": "lower", "unit": "ms/batch"}
+        for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
+                     "legacy", "block_skip", "padded", "packed",
+                     "decode"):
+            sub = row.get(mode) or {}
+            for key, unit in (("ms_per_batch", "ms/batch"),
+                              ("ms_per_call", "ms/call")):
+                ms = sub.get(key)
+                if ms is not None:
+                    out[f"{metric}.{tag}.{mode}_ms"] = {
+                        "value": float(ms), "spread": spread,
+                        "direction": "lower", "unit": unit}
+                    break   # one series per mode: a dict carrying both
+                    # keys must not overwrite ms/batch with ms/call
     return out
 
 

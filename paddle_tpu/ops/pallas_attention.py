@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as a Pallas TPU kernel — block-sparse (splash-style).
 
 The reference hand-wrote its hot kernels in CUDA (``hl_lstm``,
 ``hl_top_k``); the TPU analogue of that tier is Pallas.  This module
@@ -7,6 +7,36 @@ block per grid step with an online softmax (running max / normalizer
 kept in VMEM scratch), so the [T, T] score matrix never exists in HBM
 and VMEM holds only O(block²+block·D) — sequence length is bounded by
 HBM for q/k/v themselves, not by attention intermediates.
+
+Round 19 makes the kernel truly **block-sparse**: the (q-block,
+k-block) iteration space is flattened into a scalar-prefetched *pair
+table* that statically drops every block fully above the causal
+diagonal (≈half of T²/2 at large T), and per-row dynamic windows
+(valid-key lengths, packed segment ranges) clamp the k/v BlockSpec
+index maps so dead blocks are **neither DMA'd nor visited** — the old
+grid fetched every block and only skipped the compute (``pl.when``),
+saving FLOPs but none of the HBM traffic.  The same pair tables and
+ONE shared masking helper (:func:`_tile_mask` / element masks,
+:func:`_causal_block_live` / block liveness) drive the forward, dq and
+dk/dv kernels, so forward and backward sparsity can never diverge.
+``--flash_block_sparse=false`` restores the legacy full grid;
+``--flash_kernel=false`` restores the dense XLA composition.
+
+Three entry points:
+
+- :func:`flash_attention` — padded batches ([B, T, H, D] + optional
+  int32 [B] key lengths), causal or not;
+- :func:`flash_attention_packed` — sequence packing / ragged batching:
+  mixed-length sequences share one [B, T_total, H, D] layout with an
+  int32 segment id per token (−1 = padding; ids non-decreasing along
+  the token axis — the packing contract); cross-segment and padding
+  blocks do zero work.  ``--attention_packing=false`` upstream
+  (layers/attention.py) disables the packed lowering entirely;
+- :func:`paged_decode_attention` — the serving decode primitive: a
+  small-Tq query batch attends a block-paged KV cache through a
+  per-row page table + valid lengths (ROADMAP items 1 and 5's shared
+  base; *Ragged Paged Attention*, arxiv 2604.15464).  Inference-only
+  (no VJP).
 
 Layout matches :mod:`paddle_tpu.parallel.ring_attention`'s
 ``full_attention``: q, k, v are ``[B, T, H, D]``; output ``[B, T, H, D]``.
@@ -33,12 +63,39 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..observe import counter
+from ..utils import enforce
+from ..utils.logger import get_logger, warn_once
+
 NEG_INF = -1e30
+
+_log = get_logger("ops.attention")
 
 # jax renamed TPUCompilerParams → CompilerParams (0.5.x); resolve once
 # here so every Pallas module runs interpret-mode CI on either version.
 CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or getattr(pltpu, "TPUCompilerParams")
+
+
+def record_attention_dispatch(path: str, reason: str = "") -> None:
+    """Count one attention lowering decision (trace-time: once per
+    compiled program per shape — the ``rnn_dispatch_total`` /
+    ``conv_dispatch_total`` convention).  ``reason`` is set when a
+    flash-capable call took a fallback, with the same labels the
+    one-time fallback warnings use."""
+    counter(
+        "attention_dispatch_total",
+        "attention lowering decisions by path (trace-time; reason "
+        "labels match the one-time fallback warnings)",
+    ).inc(path=path, reason=reason)
+
+
+def _warn_dense_fallback(reason: str, tq: int, tk: int, bq: int,
+                         bk: int) -> None:
+    warn_once(
+        f"flash_attention_dense_fallback:{reason}:{tq}x{tk}",
+        "flash_attention: dense XLA fallback taken for Tq=%d Tk=%d "
+        "(blocks %d/%d): %s", tq, tk, bq, bk, reason, logger=_log)
 
 
 def _choose_block(t: int, want: int) -> int:
@@ -52,61 +109,209 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fa_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
-               acc_s, *, scale, causal, block_q, block_k, n_kblocks,
-               n_heads):
-    """Grid (B·H, q_blocks, k_blocks); k innermost so the scratch
-    accumulators carry the online softmax across k steps.  ``len_ref``
-    is the scalar-prefetched int32 [B] of valid key lengths (padded
-    batches): keys at or past the length are masked to −inf, and k
-    blocks entirely inside the padding are skipped outright."""
-    i_k = pl.program_id(2)
-    kv_len = len_ref[pl.program_id(0) // n_heads]
+# --------------------------------------------------- shared mask helpers
+def _causal_block_live(q_off, k_off, block_q):
+    """Block-level causal liveness: the (q, k) tile contains at least
+    one pair on or below the diagonal.  THE shared predicate — the
+    static pair tables, the legacy-grid skip conditions and the
+    backward kernels all call this one function, so forward and
+    backward block sparsity can never diverge.  Works on python ints
+    (table build) and traced values (kernels) alike."""
+    return k_off <= q_off + block_q - 1
 
-    @pl.when(i_k == 0)
+
+def _tile_mask(q_off, k_off, kv_len, causal, block_q, block_k,
+               seg_q=None, seg_k=None):
+    """[block_q, block_k] element validity for one tile — THE shared
+    masking helper for the forward kernel, both backward kernels and
+    the packed variants: key-padding (``kv_len``), causal diagonal,
+    and (packed) segment-id equality with −1 = padding."""
+    ki = k_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = ki < kv_len
+    if causal:
+        qi = q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = jnp.logical_and(valid, qi >= ki)
+    if seg_q is not None:
+        valid = jnp.logical_and(valid, seg_q[:, None] == seg_k[None, :])
+        valid = jnp.logical_and(valid, seg_q[:, None] >= 0)
+    return valid
+
+
+# ----------------------------------------------------------- pair tables
+@functools.lru_cache(maxsize=None)
+def _pair_tables(tq: int, tk: int, bq: int, bk: int, causal: bool,
+                 slot: int = 0):
+    """Static block-sparse iteration tables.
+
+    Returns ``(tab_q, tab_k)`` — int32 ``[4, n_pairs]`` arrays with
+    rows ``(q_block, k_block, is_first, is_last)`` — enumerating every
+    causally-live (q-block, k-block) pair in q-major order (forward /
+    dq kernels: the online-softmax / dq accumulators carry across one
+    q block's pairs) and k-major order (dk/dv kernel: the dk/dv
+    accumulators carry across one k block's pairs).  Blocks fully
+    above the causal diagonal simply do not appear: at causal T=2048
+    with 512-blocks that is 6 of 16 pairs gone — neither DMA'd nor
+    visited.  Every q block (and, since causal requires Tq == Tk,
+    every k block) keeps at least one pair, so outputs always flush.
+
+    ``slot`` (packed layouts only): tokens per packed slot when the
+    CALLER guarantees no segment crosses a slot boundary (the layer's
+    [B, T] → [1, B·T] flatten: slot = T).  Block pairs in different
+    slots are then statically dead and dropped from the table — the
+    packed grid has exactly the padded grid's pair count instead of
+    the full (B·nq)² cross product.  Only applied when slots are whole
+    blocks (slot % bq == slot % bk == 0); 0 disables.
+    """
+    nq, nk = tq // bq, tk // bk
+    if slot and (slot % bq or slot % bk):
+        slot = 0                  # blocks straddle slots: hint unusable
+
+    def build(q_major: bool):
+        rows = [[], [], [], []]
+        outer = range(nq) if q_major else range(nk)
+        inner = range(nk) if q_major else range(nq)
+        for a in outer:
+            members = []
+            for c in inner:
+                j, s = (a, c) if q_major else (c, a)
+                if causal and not _causal_block_live(
+                        j * bq, s * bk, bq):
+                    continue
+                if slot and (j * bq) // slot != (s * bk) // slot:
+                    continue
+                members.append((j, s))
+            for t, (j, s) in enumerate(members):
+                rows[0].append(j)
+                rows[1].append(s)
+                rows[2].append(1 if t == 0 else 0)
+                rows[3].append(1 if t == len(members) - 1 else 0)
+        return np.asarray(rows, np.int32)
+
+    return build(True), build(False)
+
+
+def _length_windows(lengths, bsz: int, n_outer: int, bk: int):
+    """``(lo, hi)`` int32 [B, n_outer] inclusive windows of live
+    k-block indices per (batch row, q block) from valid-key lengths:
+    the k/v index maps clamp into the window so blocks wholly inside
+    the padding re-fetch the boundary block (a no-op DMA when the
+    index repeats) instead of streaming dead data."""
+    hi = jnp.maximum((lengths + bk - 1) // bk, 1) - 1       # [B]
+    hi = jnp.broadcast_to(hi[:, None], (bsz, n_outer))
+    lo = jnp.zeros((bsz, n_outer), jnp.int32)
+    return lo, hi.astype(jnp.int32)
+
+
+def _segment_windows(seg_outer, seg_inner, b_outer: int, b_inner: int):
+    """``(lo, hi)`` int32 [B, n_outer] inclusive windows of inner
+    blocks whose valid-segment range overlaps each outer block's.
+    Relies on the packing contract (valid ids non-decreasing along the
+    token axis, −1 padding anywhere) so each block's valid ids form an
+    interval and blocks are ordered; an outer block with no valid
+    token gets an empty (lo > hi) window."""
+    bsz = seg_outer.shape[0]
+    n_o = seg_outer.shape[1] // b_outer
+    n_i = seg_inner.shape[1] // b_inner
+    big = jnp.int32(2 ** 30)
+    so = seg_outer.reshape(bsz, n_o, b_outer)
+    si = seg_inner.reshape(bsz, n_i, b_inner)
+    o_lo = jnp.min(jnp.where(so >= 0, so, big), axis=2)      # [B, n_o]
+    o_hi = jnp.max(jnp.where(so >= 0, so, -big), axis=2)
+    i_lo = jnp.min(jnp.where(si >= 0, si, big), axis=2)      # [B, n_i]
+    i_hi = jnp.max(jnp.where(si >= 0, si, -big), axis=2)
+    # inner block s overlaps outer block j iff the segment intervals
+    # intersect; all-padding blocks (empty interval) never overlap, and
+    # they may sit ANYWHERE between segments, so the window bounds come
+    # from the live blocks' indices, not from counting "blocks before"
+    live = jnp.logical_and(i_hi[:, None, :] >= o_lo[:, :, None],
+                           i_lo[:, None, :] <= o_hi[:, :, None])
+    idx = jnp.arange(n_i, dtype=jnp.int32)[None, None, :]
+    lo = jnp.min(jnp.where(live, idx, n_i), axis=2)
+    hi = jnp.max(jnp.where(live, idx, -1), axis=2)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _pair_live(tab_ref, lo_ref, hi_ref, len_ref, p, b, block_k):
+    """Scalar liveness of pair ``p`` for batch row ``b``: inside the
+    dynamic window AND the k block holds at least one valid key.
+    Shared by the forward and dq kernels (the dk/dv kernel swaps the
+    window roles — see ``_bwd_dkv_pair_kernel``)."""
+    j = tab_ref[0, p]
+    s = tab_ref[1, p]
+    live = jnp.logical_and(s >= lo_ref[b, j], s <= hi_ref[b, j])
+    return jnp.logical_and(live, s * block_k < len_ref[b])
+
+
+def _win_clip(idx, lo, hi, n: int):
+    """Clamp a block index into a dynamic [lo, hi] window and then the
+    array bound (an empty lo > hi window would otherwise produce an
+    out-of-range index for a pair that is compute-skipped anyway)."""
+    return jnp.clip(jnp.clip(idx, lo, hi), 0, n - 1)
+
+
+# ------------------------------------------------ pair-grid fwd kernel
+def _fa_pair_kernel(*refs, scale, causal, block_q, block_k, n_heads,
+                    packed):
+    """Grid (B·H, n_pairs) over the q-major pair table: the online
+    softmax carries in VMEM scratch across one q block's pairs,
+    initialized at its first table entry and flushed at its last.
+    Dead pairs (no valid key in the window) skip the compute; their
+    DMA was already skipped by the clamped index maps."""
+    if packed:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref,
+         sq_ref, sk_ref, o_ref, lse_ref, m_s, l_s, acc_s) = refs
+    else:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, m_s, l_s, acc_s) = refs
+        sq_ref = sk_ref = None
+    p = pl.program_id(1)
+    b = pl.program_id(0) // n_heads
+    kv_len = len_ref[b]
+    q_off = tab_ref[0, p] * block_q
+    k_off = tab_ref[1, p] * block_k
+
+    @pl.when(tab_ref[2, p] == 1)
     def _init():
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
-
-    q_off = pl.program_id(1) * block_q
-    k_off = i_k * block_k
 
     def _step():
         q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
         kb = k_ref[0]                                   # [bk, D]
         vb = v_ref[0]
         s = q @ kb.astype(jnp.float32).T                # [bq, bk]
-        ki = k_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = ki < kv_len
-        if causal:
-            qi = q_off + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, qi >= ki)
+        valid = _tile_mask(
+            q_off, k_off, kv_len, causal, block_q, block_k,
+            None if sq_ref is None else sq_ref[0, :, 0],
+            None if sk_ref is None else sk_ref[0, :, 0])
         s = jnp.where(valid, s, NEG_INF)
         m_prev = m_s[:]
         l_prev = l_s[:]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        # a fully-masked ROW inside a live block (packed: padding
+        # queries sharing a block with valid ones) has m_new = NEG_INF;
+        # exp(s − m_new) would be exp(0) = 1 and leak mass — clamp the
+        # exponent base so those rows underflow to 0 instead (the
+        # flush's l_safe then emits exact zeros)
+        m_base = jnp.maximum(m_new, NEG_INF / 2)
+        pexp = jnp.exp(s - m_base)
+        alpha = jnp.exp(m_prev - m_base)
         m_s[:] = m_new
-        l_s[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
-        acc_s[:] = acc_s[:] * alpha + p @ vb.astype(jnp.float32)
+        l_s[:] = l_prev * alpha + pexp.sum(axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + pexp @ vb.astype(jnp.float32)
 
-    # skip k blocks with no valid key: fully above the causal diagonal
-    # or fully inside the padding
-    live = k_off < kv_len
-    if causal:
-        live = jnp.logical_and(live, k_off <= q_off + block_q - 1)
-    pl.when(live)(_step)
+    pl.when(_pair_live(tab_ref, lo_ref, hi_ref, len_ref, p, b,
+                       block_k))(_step)
 
-    @pl.when(i_k == n_kblocks - 1)
+    @pl.when(tab_ref[3, p] == 1)
     def _flush():
-        # guard fully-masked rows (query past a zero-length sequence):
-        # l = 0 → emit 0 not NaN, and clamp m away from NEG_INF so the
-        # backward's p = exp(s − lse) underflows to 0 instead of
-        # exp(NEG_INF − NEG_INF) = 1 leaking gradients into padding
+        # guard fully-masked rows (query past a zero-length sequence /
+        # padding segment): l = 0 → emit 0 not NaN, and clamp m away
+        # from NEG_INF so the backward's p = exp(s − lse) underflows to
+        # 0 instead of exp(NEG_INF − NEG_INF) = 1 leaking gradients
         l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
         m_safe = jnp.maximum(m_s[:], NEG_INF / 2)
         o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
@@ -126,8 +331,21 @@ def _tiling_ok(tq: int, tk: int, bq: int, bk: int) -> bool:
     return ok_q and ok_k
 
 
-def _mask_scores(s, causal, lengths):
-    """Apply causal and key-padding masks to [B, H, Tq, Tk] scores."""
+def packed_tileable(t_total: int, block_q: int, block_k: int) -> bool:
+    """Would a packed (flattened, self-attention) layout of
+    ``t_total`` tokens hit the Pallas kernels?  The layer pre-checks
+    this and reverts an untileable flatten to the padded per-row
+    lowering — the op-level dense fallback on a [1, B·T] axis would
+    build an O((B·T)²) score matrix."""
+    bq = _choose_block(t_total, block_q)
+    bk = _choose_block(t_total, block_k)
+    return _tiling_ok(t_total, t_total, bq, bk)
+
+
+def _mask_scores(s, causal, lengths, segments=None):
+    """Apply causal / key-padding / packed-segment masks to
+    [B, H, Tq, Tk] scores — the dense-path twin of :func:`_tile_mask`
+    (same semantics at full-matrix granularity)."""
     tq, tk = s.shape[-2], s.shape[-1]
     if causal:
         s = jnp.where(jnp.arange(tq)[None, None, :, None]
@@ -135,16 +353,21 @@ def _mask_scores(s, causal, lengths):
     if lengths is not None:
         valid = jnp.arange(tk)[None, :] < lengths[:, None]   # [B, Tk]
         s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    if segments is not None:
+        sq = segments[:, None, :, None]                      # [B,1,Tq,1]
+        sk = segments[:, None, None, :]
+        s = jnp.where(jnp.logical_and(sq == sk, sq >= 0), s, NEG_INF)
     return s
 
 
-def _dense_forward(q, k, v, lengths, causal):
-    """Fallback for shapes the kernel can't tile: plain XLA attention,
+def _dense_forward(q, k, v, lengths, causal, segments=None):
+    """Fallback for shapes the kernel can't tile (and the exact
+    unfused reference the kill switches restore): plain XLA attention,
     same (out, lse) contract so the shared backward rule applies."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    s = _mask_scores(s, causal, lengths)
+    s = _mask_scores(s, causal, lengths, segments)
     m = s.max(axis=-1)
     # fully-masked rows (query past a zero-length sequence): emit 0
     m_safe = jnp.maximum(m, NEG_INF / 2)
@@ -156,23 +379,152 @@ def _dense_forward(q, k, v, lengths, causal):
     return out.astype(q.dtype), lse
 
 
-def _fa_forward(q, k, v, lengths, causal, block_q, block_k):
+def _heads_first(a, b, t, h, d):
+    """[B, T, H, D] → [B·H, T, D] so one grid row owns one head."""
+    return a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _fa_forward_sparse(q, k, v, lengths, causal, bq, bk,
+                       segments=None, slot=0):
+    """Pair-table (block-sparse) forward: grid (B·H, n_pairs)."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    if causal:
-        # a causal mask is only meaningful on a shared timeline
-        assert tq == tk, f"causal attention needs Tq == Tk, got {tq}/{tk}"
-    bq = _choose_block(tq, block_q)
-    bk = _choose_block(tk, block_k)
-    if lengths is None:
-        lengths = jnp.full((b,), tk, jnp.int32)
-    if not _tiling_ok(tq, tk, bq, bk):
-        return _dense_forward(q, k, v, lengths, causal)
     scale = 1.0 / np.sqrt(d)
-    # [B, T, H, D] → [B*H, T, D] so one grid row owns one head
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    qh = _heads_first(q, b, tq, h, d)
+    kh = _heads_first(k, b, tk, h, d)
+    vh = _heads_first(v, b, tk, h, d)
+    nq, nk = tq // bq, tk // bk
+    tab = jnp.asarray(_pair_tables(tq, tk, bq, bk, causal, slot)[0])
+    n_pairs = tab.shape[1]
+    if segments is None:
+        lo, hi = _length_windows(lengths, b, nq, bk)
+    else:
+        lo, hi = _segment_windows(segments, segments, bq, bk)
+    nh = h
+
+    def q_idx(i, p, ln, lo_, hi_, tb):
+        return (i, tb[0, p], 0)
+
+    def kv_idx(i, p, ln, lo_, hi_, tb):
+        j = tb[0, p]
+        return (i, _win_clip(tb[1, p], lo_[i // nh, j],
+                             hi_[i // nh, j], nk), 0)
+
+    def sq_idx(i, p, ln, lo_, hi_, tb):
+        return (i // nh, tb[0, p], 0)
+
+    def sk_idx(i, p, ln, lo_, hi_, tb):
+        j = tb[0, p]
+        return (i // nh, _win_clip(tb[1, p], lo_[i // nh, j],
+                                   hi_[i // nh, j], nk), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), q_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
+    ]
+    operands = [qh, kh, vh]
+    if segments is not None:
+        seg3 = segments.astype(jnp.int32).reshape(b, tq, 1)
+        in_specs += [pl.BlockSpec((1, bq, 1), sq_idx),
+                     pl.BlockSpec((1, bk, 1), sk_idx)]
+        operands += [seg3, seg3]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b * h, n_pairs),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), q_idx),
+            pl.BlockSpec((1, 8, bq),
+                         lambda i, p, ln, lo_, hi_, tb: (i, 0, tb[0, p])),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),       # running max
+            pltpu.VMEM((bq, 1), jnp.float32),       # running normalizer
+            pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
+        ],
+    )
+    kernel = functools.partial(
+        _fa_pair_kernel, scale=scale, causal=causal, block_q=bq,
+        block_k=bk, n_heads=h, packed=segments is not None)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, tq), jnp.float32),
+        ],
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), lo, hi, tab, *operands)
+    out = out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, 0, :].reshape(b, h, tq)
+    return out, lse
+
+
+# --------------------------------------------------- legacy full grid
+def _fa_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
+               acc_s, *, scale, causal, block_q, block_k, n_kblocks,
+               n_heads):
+    """Legacy grid (B·H, q_blocks, k_blocks); k innermost so the
+    scratch accumulators carry the online softmax across k steps.
+    Every k/v block is DMA'd; ``pl.when`` skips only the compute —
+    kept byte-for-byte behind ``--flash_block_sparse=false``."""
+    i_k = pl.program_id(2)
+    kv_len = len_ref[pl.program_id(0) // n_heads]
+
+    @pl.when(i_k == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    q_off = pl.program_id(1) * block_q
+    k_off = i_k * block_k
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale        # [bq, D]
+        kb = k_ref[0]                                   # [bk, D]
+        vb = v_ref[0]
+        s = q @ kb.astype(jnp.float32).T                # [bq, bk]
+        valid = _tile_mask(q_off, k_off, kv_len, causal, block_q,
+                           block_k)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_s[:]
+        l_prev = l_s[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_s[:] = m_new
+        l_s[:] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + p @ vb.astype(jnp.float32)
+
+    # skip k blocks with no valid key: fully above the causal diagonal
+    # or fully inside the padding (compute only — the DMA already ran)
+    live = k_off < kv_len
+    if causal:
+        live = jnp.logical_and(live,
+                               _causal_block_live(q_off, k_off, block_q))
+    pl.when(live)(_step)
+
+    @pl.when(i_k == n_kblocks - 1)
+    def _flush():
+        l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        m_safe = jnp.maximum(m_s[:], NEG_INF / 2)
+        o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_safe + jnp.log(l_safe))[:, 0][None, :], (8, block_q))
+
+
+def _fa_forward_grid(q, k, v, lengths, causal, bq, bk):
+    """Legacy full-grid forward (``--flash_block_sparse=false``)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    qh = _heads_first(q, b, tq, h, d)
+    kh = _heads_first(k, b, tk, h, d)
+    vh = _heads_first(v, b, tk, h, d)
     n_kblocks = tk // bk
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=bq, block_k=bk,
@@ -211,13 +563,79 @@ def _fa_forward(q, k, v, lengths, causal, block_q, block_k):
     return out, lse
 
 
+def _block_sparse() -> bool:
+    from ..utils import FLAGS
+
+    return bool(FLAGS.flash_block_sparse)
+
+
+def _flash_enabled() -> bool:
+    from ..utils import FLAGS
+
+    return bool(FLAGS.flash_kernel)
+
+
+def _fa_forward(q, k, v, lengths, causal, block_q, block_k,
+                segments=None, slot=0):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if causal:
+        # a causal mask is only meaningful on a shared timeline
+        enforce(tq == tk,
+                f"causal attention needs Tq == Tk, got {tq}/{tk}")
+    bq = _choose_block(tq, block_q)
+    bk = _choose_block(tk, block_k)
+    if lengths is None:
+        lengths = jnp.full((b,), tk, jnp.int32)
+    packed = segments is not None
+    if packed:
+        enforce(tq == tk, "packed attention is self-attention: one "
+                          f"segment table, Tq == Tk, got {tq}/{tk}")
+    if not _flash_enabled():
+        record_attention_dispatch(
+            "dense", "kill_switch:flash_kernel")
+        return _dense_forward(q, k, v, lengths, causal, segments)
+    if not _tiling_ok(tq, tk, bq, bk):
+        reason = "untileable shape (lse/kv block constraints)"
+        record_attention_dispatch("dense", reason)
+        _warn_dense_fallback(reason, tq, tk, bq, bk)
+        return _dense_forward(q, k, v, lengths, causal, segments)
+    if _block_sparse():
+        reason = ""
+        if packed and slot and (slot % bq or slot % bk):
+            # the slot hint can only drop cross-slot pairs when slots
+            # are whole blocks; otherwise the grid keeps the full
+            # cross product (windows still skip the compute + DMA,
+            # but every pair is a scheduled step — O(B²) grid growth)
+            reason = "slot hint unusable (blocks straddle slots)"
+            warn_once(
+                f"flash_attention_packed_slot:{slot}:{bq}x{bk}",
+                "flash_attention_packed: slot hint %d unusable with "
+                "blocks %d/%d (not whole blocks per slot); the pair "
+                "table keeps the full cross product — prefer blocks "
+                "dividing the slot width", slot, bq, bk, logger=_log)
+        record_attention_dispatch("packed" if packed
+                                   else "block_sparse", reason)
+        return _fa_forward_sparse(q, k, v, lengths, causal, bq, bk,
+                                  segments, slot)
+    if packed:
+        # the legacy grid has no segment plumbing: exact dense fallback
+        record_attention_dispatch(
+            "dense", "kill_switch:flash_block_sparse(packed)")
+        return _dense_forward(q, k, v, lengths, causal, segments)
+    record_attention_dispatch("legacy_grid",
+                               "kill_switch:flash_block_sparse")
+    return _fa_forward_grid(q, k, v, lengths, causal, bq, bk)
+
+
 # ------------------------------------------------------ backward kernels
 def _recompute_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      q_off, k_off, kv_len, scale, causal, block_q,
-                     block_k):
+                     block_k, seg_q=None, seg_k=None):
     """Rebuild one (q-block, k-block) softmax tile from the saved
     logsumexp and return (p, ds, q, kb, do) in f32 — shared by the dq
-    and dk/dv kernels so their masking/scaling can never diverge."""
+    and dk/dv kernels (legacy AND pair-grid) so their masking/scaling
+    can never diverge from the forward's :func:`_tile_mask`."""
     q = q_ref[0].astype(jnp.float32)
     kb = k_ref[0].astype(jnp.float32)
     vb = v_ref[0].astype(jnp.float32)
@@ -225,32 +643,273 @@ def _recompute_block(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lse = lse_ref[0, 0].astype(jnp.float32)[:, None]         # [bq, 1]
     delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
     s = (q @ kb.T) * scale
-    ki = k_off + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    valid = ki < kv_len
-    if causal:
-        qi = q_off + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        valid = jnp.logical_and(valid, qi >= ki)
+    valid = _tile_mask(q_off, k_off, kv_len, causal, block_q, block_k,
+                       seg_q, seg_k)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     ds = p * (do @ vb.T - delta)
     return p, ds, q, kb, do
 
 
 def _bwd_live(q_off, k_off, kv_len, causal, block_q):
-    """Skip condition shared by both backward kernels: a block with no
-    valid key (padding tail or fully above the causal diagonal)."""
+    """Legacy-grid skip condition shared by both backward kernels: a
+    block with no valid key (padding tail or fully above the causal
+    diagonal)."""
     live = k_off < kv_len
     if causal:
-        live = jnp.logical_and(live, k_off <= q_off + block_q - 1)
+        live = jnp.logical_and(live,
+                               _causal_block_live(q_off, k_off, block_q))
     return live
+
+
+def _bwd_dq_pair_kernel(*refs, scale, causal, block_q, block_k,
+                        n_heads, packed):
+    """Grid (B·H, n_pairs) over the q-major pair table: accumulate dq
+    for one q block across its (causally-live) k pairs."""
+    if packed:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref, do_ref,
+         lse_ref, delta_ref, sq_ref, sk_ref, dq_ref, acc_s) = refs
+    else:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref, do_ref,
+         lse_ref, delta_ref, dq_ref, acc_s) = refs
+        sq_ref = sk_ref = None
+    p = pl.program_id(1)
+    b = pl.program_id(0) // n_heads
+    kv_len = len_ref[b]
+    q_off = tab_ref[0, p] * block_q
+    k_off = tab_ref[1, p] * block_k
+
+    @pl.when(tab_ref[2, p] == 1)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    def _step():
+        _p, ds, _q, kb, _do = _recompute_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_off,
+            k_off, kv_len, scale, causal, block_q, block_k,
+            None if sq_ref is None else sq_ref[0, :, 0],
+            None if sk_ref is None else sk_ref[0, :, 0])
+        acc_s[:] = acc_s[:] + ds @ kb * scale
+
+    pl.when(_pair_live(tab_ref, lo_ref, hi_ref, len_ref, p, b,
+                       block_k))(_step)
+
+    @pl.when(tab_ref[3, p] == 1)
+    def _flush():
+        dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_pair_kernel(*refs, scale, causal, block_q, block_k,
+                         n_heads, packed):
+    """Grid (B·H, n_pairs) over the k-major pair table: accumulate
+    dk/dv for one k block across its (causally-live) q pairs.  The
+    dynamic window here runs over q blocks (packed segments); the
+    key-padding liveness keeps the k-block-vs-length test."""
+    if packed:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref, do_ref,
+         lse_ref, delta_ref, sq_ref, sk_ref, dk_ref, dv_ref, dk_s,
+         dv_s) = refs
+    else:
+        (len_ref, lo_ref, hi_ref, tab_ref, q_ref, k_ref, v_ref, do_ref,
+         lse_ref, delta_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
+        sq_ref = sk_ref = None
+    p = pl.program_id(1)
+    b = pl.program_id(0) // n_heads
+    kv_len = len_ref[b]
+    j = tab_ref[0, p]
+    s_blk = tab_ref[1, p]
+    q_off = j * block_q
+    k_off = s_blk * block_k
+
+    @pl.when(tab_ref[2, p] == 1)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    def _step():
+        pw, ds, q, _kb, do = _recompute_block(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_off,
+            k_off, kv_len, scale, causal, block_q, block_k,
+            None if sq_ref is None else sq_ref[0, :, 0],
+            None if sk_ref is None else sk_ref[0, :, 0])
+        dv_s[:] = dv_s[:] + pw.T @ do
+        dk_s[:] = dk_s[:] + ds.T @ q * scale
+
+    live = jnp.logical_and(j >= lo_ref[b, s_blk], j <= hi_ref[b, s_blk])
+    live = jnp.logical_and(live, k_off < kv_len)
+    pl.when(live)(_step)
+
+    @pl.when(tab_ref[3, p] == 1)
+    def _flush():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd_residual_streams(q, k, v, out, do, lse):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qh = _heads_first(q, b, tq, h, d)
+    kh = _heads_first(k, b, tk, h, d)
+    vh = _heads_first(v, b, tk, h, d)
+    doh = _heads_first(do, b, tq, h, d)
+    # delta_i = Σ_d dO_i·O_i (softmax-backward row term), [BH, 1, T]
+    delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                       out.astype(jnp.float32)).reshape(b * h, 1, tq)
+    lse3 = lse.reshape(b * h, 1, tq)
+    return qh, kh, vh, doh, delta, lse3
+
+
+def _fa_backward_sparse(q, k, v, lengths, out, lse, do, causal, bq, bk,
+                        segments=None, slot=0):
+    """Pair-table (block-sparse) backward: two kernels over the shared
+    tables — dq over the q-major order, dk/dv over the k-major order —
+    so the backward traffic shrinks by exactly the forward's skip
+    fraction."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    qh, kh, vh, doh, delta, lse3 = _bwd_residual_streams(
+        q, k, v, out, do, lse)
+    lengths = lengths.astype(jnp.int32)
+    nq, nk = tq // bq, tk // bk
+    tab_q, tab_k = _pair_tables(tq, tk, bq, bk, causal, slot)
+    tab_q = jnp.asarray(tab_q)
+    tab_k = jnp.asarray(tab_k)
+    if segments is None:
+        lo_q, hi_q = _length_windows(lengths, b, nq, bk)
+        lo_k = jnp.zeros((b, nk), jnp.int32)
+        hi_k = jnp.full((b, nk), nq - 1, jnp.int32)
+    else:
+        lo_q, hi_q = _segment_windows(segments, segments, bq, bk)
+        lo_k, hi_k = _segment_windows(segments, segments, bk, bq)
+    nh = h
+    packed = segments is not None
+
+    def q_idx(i, p, ln, lo_, hi_, tb):
+        return (i, tb[0, p], 0)
+
+    def kv_idx(i, p, ln, lo_, hi_, tb):
+        j = tb[0, p]
+        return (i, _win_clip(tb[1, p], lo_[i // nh, j],
+                             hi_[i // nh, j], nk), 0)
+
+    def row_idx(i, p, ln, lo_, hi_, tb):
+        return (i, 0, tb[0, p])
+
+    def sq_idx(i, p, ln, lo_, hi_, tb):
+        return (i // nh, tb[0, p], 0)
+
+    def sk_idx(i, p, ln, lo_, hi_, tb):
+        j = tb[0, p]
+        return (i // nh, _win_clip(tb[1, p], lo_[i // nh, j],
+                                   hi_[i // nh, j], nk), 0)
+
+    common = dict(
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )
+    in_specs = [
+        pl.BlockSpec((1, bq, d), q_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
+        pl.BlockSpec((1, bq, d), q_idx),
+        pl.BlockSpec((1, 1, bq), row_idx),
+        pl.BlockSpec((1, 1, bq), row_idx),
+    ]
+    operands = [qh, kh, vh, doh, lse3, delta]
+    if packed:
+        seg3 = segments.astype(jnp.int32).reshape(b, tq, 1)
+        in_specs += [pl.BlockSpec((1, bq, 1), sq_idx),
+                     pl.BlockSpec((1, bk, 1), sk_idx)]
+        operands += [seg3, seg3]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_pair_kernel, scale=scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          n_heads=h, packed=packed),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b * h, int(tab_q.shape[1])),
+            in_specs=in_specs,
+            out_specs=[pl.BlockSpec((1, bq, d), q_idx)],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32)],
+        **common,
+    )(lengths, lo_q, hi_q, tab_q, *operands)[0]
+
+    # k-major order: q/do/lse/delta stream per pair (their q-block index
+    # is the table's, window-clamped in packed mode); k/v/dk/dv are the
+    # per-k-block residents
+    def q_idx2(i, p, ln, lo_, hi_, tb):
+        s_ = tb[1, p]
+        return (i, _win_clip(tb[0, p], lo_[i // nh, s_],
+                             hi_[i // nh, s_], nq), 0)
+
+    def kv_idx2(i, p, ln, lo_, hi_, tb):
+        return (i, tb[1, p], 0)
+
+    def row_idx2(i, p, ln, lo_, hi_, tb):
+        s_ = tb[1, p]
+        return (i, 0, _win_clip(tb[0, p], lo_[i // nh, s_],
+                                hi_[i // nh, s_], nq))
+
+    def sq_idx2(i, p, ln, lo_, hi_, tb):
+        s_ = tb[1, p]
+        return (i // nh, _win_clip(tb[0, p], lo_[i // nh, s_],
+                                   hi_[i // nh, s_], nq), 0)
+
+    def sk_idx2(i, p, ln, lo_, hi_, tb):
+        return (i // nh, tb[1, p], 0)
+
+    in_specs2 = [
+        pl.BlockSpec((1, bq, d), q_idx2),
+        pl.BlockSpec((1, bk, d), kv_idx2),
+        pl.BlockSpec((1, bk, d), kv_idx2),
+        pl.BlockSpec((1, bq, d), q_idx2),
+        pl.BlockSpec((1, 1, bq), row_idx2),
+        pl.BlockSpec((1, 1, bq), row_idx2),
+    ]
+    operands2 = [qh, kh, vh, doh, lse3, delta]
+    if packed:
+        seg3 = segments.astype(jnp.int32).reshape(b, tq, 1)
+        in_specs2 += [pl.BlockSpec((1, bq, 1), sq_idx2),
+                      pl.BlockSpec((1, bk, 1), sk_idx2)]
+        operands2 += [seg3, seg3]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_pair_kernel, scale=scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          n_heads=h, packed=packed),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b * h, int(tab_k.shape[1])),
+            in_specs=in_specs2,
+            out_specs=[
+                pl.BlockSpec((1, bk, d), kv_idx2),
+                pl.BlockSpec((1, bk, d), kv_idx2),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tk, d), jnp.float32),
+        ],
+        **common,
+    )(lengths, lo_k, hi_k, tab_k, *operands2)
+
+    unpack_q = lambda a: a.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    unpack_k = lambda a: a.reshape(b, h, tk, d).transpose(0, 2, 1, 3)
+    return (unpack_q(dq).astype(q.dtype), unpack_k(dk).astype(k.dtype),
+            unpack_k(dv).astype(v.dtype))
 
 
 def _bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, acc_s, *, scale, causal, block_q,
                    block_k, n_kblocks, n_heads):
-    """Grid (B·H, q_blocks, k_blocks), k innermost: accumulate dq for
-    one q block while k/v stream through VMEM."""
+    """Legacy grid (B·H, q_blocks, k_blocks), k innermost: accumulate
+    dq for one q block while k/v stream through VMEM."""
     i_k = pl.program_id(2)
     kv_len = len_ref[pl.program_id(0) // n_heads]
 
@@ -277,8 +936,8 @@ def _bwd_dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _bwd_dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_s, dv_s, *, scale,
                     causal, block_q, block_k, n_qblocks, n_heads):
-    """Grid (B·H, k_blocks, q_blocks), q innermost: accumulate dk/dv
-    for one k block while q/do stream through VMEM."""
+    """Legacy grid (B·H, k_blocks, q_blocks), q innermost: accumulate
+    dk/dv for one k block while q/do stream through VMEM."""
     i_q = pl.program_id(2)
     kv_len = len_ref[pl.program_id(0) // n_heads]
 
@@ -306,21 +965,13 @@ def _bwd_dkv_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _fa_backward_pallas(q, k, v, lengths, out, lse, do, causal, bq, bk):
-    """Blockwise backward: (dq, dk, dv) without a [T, T] score matrix
-    in HBM.  q/do layouts as in forward ([B, T, H, D])."""
+    """Legacy blockwise backward (``--flash_block_sparse=false``):
+    (dq, dk, dv) without a [T, T] score matrix in HBM, full grid."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    doh = do.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    # delta_i = Σ_d dO_i·O_i (softmax-backward row term), [BH, 1, T]
-    delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
-                       out.astype(jnp.float32)).reshape(b * h, 1, tq)
-    lse3 = lse.reshape(b * h, 1, tq)
-    if lengths is None:
-        lengths = jnp.full((b,), tk, jnp.int32)
+    qh, kh, vh, doh, delta, lse3 = _bwd_residual_streams(
+        q, k, v, out, do, lse)
     lengths = lengths.astype(jnp.int32)
 
     common = dict(
@@ -389,6 +1040,49 @@ def _fa_backward_pallas(q, k, v, lengths, out, lse, do, causal, bq, bk):
             unpack_k(dv).astype(v.dtype))
 
 
+def _dense_backward(q, k, v, lengths, out, lse, do, causal,
+                    segments=None):
+    """Dense einsum backward — the exact composition the kill switches
+    and untileable shapes fall back to."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    s = _mask_scores(s, causal, lengths, segments)
+    p = jnp.exp(s - lse[:, :, :, None])                 # softmax weights
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    # delta_i = Σ_d dO_i·O_i (the softmax-backward row term)
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, of)
+    ds = p * (dp - delta[:, :, :, None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+def _fa_backward(q, k, v, lengths, out, lse, do, causal, block_q,
+                 block_k, segments=None, slot=0):
+    """Backward dispatch — mirrors :func:`_fa_forward` exactly (same
+    flags, same tiling gate) so one compiled program's forward and
+    backward always take matching paths."""
+    tq, tk = q.shape[1], k.shape[1]
+    bq = _choose_block(tq, block_q)
+    bk = _choose_block(tk, block_k)
+    if _flash_enabled() and _tiling_ok(tq, tk, bq, bk):
+        if _block_sparse():
+            return _fa_backward_sparse(q, k, v, lengths, out, lse, do,
+                                       causal, bq, bk, segments, slot)
+        if segments is None:
+            return _fa_backward_pallas(q, k, v, lengths, out, lse, do,
+                                       causal, bq, bk)
+    return _dense_backward(q, k, v, lengths, out, lse, do, causal,
+                           segments)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention(q, k, v, lengths=None, causal: bool = False,
                     block_q: int = 512, block_k: int = 512):
@@ -396,7 +1090,9 @@ def flash_attention(q, k, v, lengths=None, causal: bool = False,
 
     q, k, v: ``[B, T, H, D]``; returns ``[B, T, H, D]`` in q's dtype.
     ``lengths``: optional int32 [B] valid key lengths for padded batches
-    — keys at or past the length are masked out of the softmax.
+    — keys at or past the length are masked out of the softmax, and
+    (block-sparse path) k/v blocks wholly past the length are neither
+    DMA'd nor visited.
     """
     out, _lse = _fa_forward(q, k, v, lengths, causal, block_q, block_k)
     return out
@@ -409,32 +1105,228 @@ def _fa_fwd_rule(q, k, v, lengths, causal, block_q, block_k):
 
 def _fa_bwd_rule(causal, block_q, block_k, res, do):
     q, k, v, lengths, out, lse = res
-    d = q.shape[-1]
-    tq, tk = q.shape[1], k.shape[1]
-    bq = _choose_block(tq, block_q)
-    bk = _choose_block(tk, block_k)
-    if _tiling_ok(tq, tk, bq, bk):
-        dq, dk, dv = _fa_backward_pallas(q, k, v, lengths, out, lse, do,
-                                         causal, bq, bk)
-        return dq, dk, dv, None
-    scale = 1.0 / np.sqrt(d)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
-    s = _mask_scores(s, causal, lengths)
-    p = jnp.exp(s - lse[:, :, :, None])                 # softmax weights
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
-    # delta_i = Σ_d dO_i·O_i (the softmax-backward row term)
-    delta = jnp.einsum("bqhd,bqhd->bhq", dof, of)
-    ds = p * (dp - delta[:, :, :, None])
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            None)
+    if lengths is None:
+        lengths = jnp.full((q.shape[0],), k.shape[1], jnp.int32)
+    dq, dk, dv = _fa_backward(q, k, v, lengths, out, lse, do, causal,
+                              block_q, block_k)
+    return dq, dk, dv, None
 
 
 flash_attention.defvjp(_fa_fwd_rule, _fa_bwd_rule)
+
+
+# ------------------------------------------------------ sequence packing
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_packed(q, k, v, segments, causal: bool = False,
+                           block_q: int = 512, block_k: int = 512,
+                           slot: int = 0):
+    """Packed (ragged-batch) attention: tokens attend only within
+    their segment.
+
+    q, k, v: ``[B, T_total, H, D]`` — mixed-length sequences share one
+    packed token axis; ``segments``: int32 ``[B, T_total]`` per-token
+    segment ids, **non-decreasing** over valid tokens with ``-1``
+    marking padding (the packing contract — the dynamic block windows
+    rely on it).  Padding tokens produce zero output and zero grads;
+    cross-segment and padding blocks are neither DMA'd nor visited on
+    the block-sparse path.  ``causal`` applies within segments (packed
+    positions are globally ordered, so the global diagonal is the
+    per-segment diagonal).  ``slot``: optional static slot width when
+    the caller guarantees no segment crosses a slot boundary — pairs
+    across slots leave the iteration space entirely (see
+    :func:`_pair_tables`).
+    """
+    out, _lse = _fa_forward(q, k, v, None, causal, block_q, block_k,
+                            segments=segments, slot=slot)
+    return out
+
+
+def _fa_packed_fwd_rule(q, k, v, segments, causal, block_q, block_k,
+                        slot):
+    out, lse = _fa_forward(q, k, v, None, causal, block_q, block_k,
+                           segments=segments, slot=slot)
+    return out, (q, k, v, segments, out, lse)
+
+
+def _fa_packed_bwd_rule(causal, block_q, block_k, slot, res, do):
+    q, k, v, segments, out, lse = res
+    lengths = jnp.full((q.shape[0],), k.shape[1], jnp.int32)
+    dq, dk, dv = _fa_backward(q, k, v, lengths, out, lse, do, causal,
+                              block_q, block_k, segments=segments,
+                              slot=slot)
+    return dq, dk, dv, None
+
+
+flash_attention_packed.defvjp(_fa_packed_fwd_rule, _fa_packed_bwd_rule)
+
+
+def segments_from_lengths(lengths, batch: int, t: int):
+    """Per-token segment ids for a padded ``[B, T]`` batch flattened to
+    one packed ``[1, B·T]`` row: valid tokens of row i get id ``i``,
+    padding gets ``-1`` (ids non-decreasing — the packing contract)."""
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]            # [1, T]
+    row = jnp.arange(batch, dtype=jnp.int32)[:, None]        # [B, 1]
+    seg = jnp.where(pos < lengths[:, None], row, -1)         # [B, T]
+    return seg.reshape(1, batch * t)
+
+
+# --------------------------------------------------- paged-KV decode
+def _decode_kernel(len_ref, used_ref, pidx_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_s, l_s, acc_s, *, scale, page, t_q,
+                   n_heads, n_pages_max):
+    """Grid (B·H, max_pages_per_row): one query tile (small Tq — the
+    decode step's new tokens) attends its row's paged KV cache, one
+    physical page per grid step, via the scalar-prefetched page table.
+    Pages wholly past the row's length are clamped to the last used
+    page (no DMA when the index repeats) and compute-skipped."""
+    i = pl.program_id(0)
+    p = pl.program_id(1)
+    b = i // n_heads
+    kv_len = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale         # [tq, D]
+        kb = k_ref[0]                                    # [page, D]
+        vb = v_ref[0]
+        s = q @ kb.astype(jnp.float32).T                 # [tq, page]
+        ki = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (t_q, page), 1)
+        # query r sits at absolute position kv_len - t_q + r: it may
+        # attend every key at or before itself (ragged causal tail)
+        qpos = kv_len - t_q + jax.lax.broadcasted_iota(
+            jnp.int32, (t_q, page), 0)
+        valid = ki <= qpos
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_s[:]
+        l_prev = l_s[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        # fully-masked query rows (0 < length < Tq: the leading rows of
+        # a speculative/chunked tile sit at negative positions) have
+        # m_new = NEG_INF; clamp the exponent base so exp(s − m) under-
+        # flows to 0 instead of exp(−inf − (−inf)) = 1 leaking V mass —
+        # same guard as _fa_pair_kernel, flush's l_safe emits zeros
+        m_base = jnp.maximum(m_new, NEG_INF / 2)
+        pexp = jnp.exp(s - m_base)
+        alpha = jnp.exp(m_prev - m_base)
+        # Pallas VMEM scratch refs are the kernel's mutable-by-design
+        # accumulator API (this kernel is jit-reachable directly, not
+        # through a custom_vjp wrapper, so PT-TRACE sees the writes)
+        m_s[:] = m_new                          # ptpu: lint-ok[PT-TRACE]
+        # ptpu: lint-ok[PT-TRACE]
+        l_s[:] = l_prev * alpha + pexp.sum(axis=-1, keepdims=True)
+        # ptpu: lint-ok[PT-TRACE]
+        acc_s[:] = acc_s[:] * alpha + pexp @ vb.astype(jnp.float32)
+
+    pl.when(p * page < kv_len)(_step)
+
+    @pl.when(p == n_pages_max - 1)
+    def _flush():
+        l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_indices, lengths):
+    """Decode-step attention over a block-paged KV cache.
+
+    - ``q``: ``[B, Tq, H, D]`` — the row's newest ``Tq`` tokens (Tq is
+      small: 1 for plain decode, >1 for speculative/chunked steps);
+    - ``k_pages`` / ``v_pages``: ``[P, page_size, H, D]`` physical
+      page pools shared by every row;
+    - ``page_indices``: int32 ``[B, max_pages]`` per-row page table
+      (entries past the row's used pages are ignored);
+    - ``lengths``: int32 ``[B]`` valid cached tokens per row — the
+      query tile occupies positions ``length - Tq … length - 1``, so
+      the current step's K/V must already be written to the pages.
+
+    Returns ``[B, Tq, H, D]``.  Inference-only (no custom VJP): this is
+    the serving decode primitive (ROADMAP item 1) exercised standalone.
+    """
+    b, t_q, h, d = q.shape
+    n_pages, page, hp, dp = k_pages.shape
+    enforce(hp == h and dp == d,
+            f"page pool heads/dim {hp}/{dp} != query {h}/{d}")
+    enforce(v_pages.shape == k_pages.shape,
+            "k_pages and v_pages shapes differ: "
+            f"{k_pages.shape} vs {v_pages.shape}")
+    enforce(page_indices.shape[0] == b and lengths.shape == (b,),
+            f"page_indices/lengths batch mismatch: "
+            f"{page_indices.shape}/{lengths.shape} vs B={b}")
+    n_pages_max = page_indices.shape[1]
+    record_attention_dispatch("decode")
+    scale = 1.0 / np.sqrt(d)
+    lengths = lengths.astype(jnp.int32)
+    # pages become rows of one [H·P, page, D] pool so a single index
+    # computed from (head, page table) addresses a (page, D) block
+    kp = k_pages.transpose(2, 0, 1, 3).reshape(h * n_pages, page, d)
+    vp = v_pages.transpose(2, 0, 1, 3).reshape(h * n_pages, page, d)
+    qh = _heads_first(q, b, t_q, h, d)
+    used = jnp.maximum((lengths + page - 1) // page, 1)      # [B]
+    nh = h
+
+    def kv_idx(i, p, ln, us, pi):
+        bb = i // nh
+        slot = jnp.minimum(p, us[bb] - 1)
+        return ((i % nh) * n_pages + pi[bb, slot], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page=page,
+                          t_q=t_q, n_heads=h,
+                          n_pages_max=n_pages_max),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b * h, n_pages_max),
+            in_specs=[
+                pl.BlockSpec((1, t_q, d),
+                             lambda i, p, ln, us, pi: (i, 0, 0)),
+                pl.BlockSpec((1, page, d), kv_idx),
+                pl.BlockSpec((1, page, d), kv_idx),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, t_q, d),
+                             lambda i, p, ln, us, pi: (i, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((t_q, 1), jnp.float32),
+                pltpu.VMEM((t_q, 1), jnp.float32),
+                pltpu.VMEM((t_q, d), jnp.float32),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype)],
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(lengths, used, page_indices.astype(jnp.int32), qh, kp, vp)[0]
+    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+def paged_decode_reference(q, k_pages, v_pages, page_indices, lengths):
+    """Dense one-step reference for :func:`paged_decode_attention`
+    (tests; also the numerics contract): gather each row's pages into
+    a contiguous [B, max_pages·page, H, D] cache and run the dense
+    masked attention."""
+    b, t_q, h, d = q.shape
+    page = k_pages.shape[1]
+    n_max = page_indices.shape[1]
+    gk = k_pages[page_indices.reshape(-1)].reshape(
+        b, n_max * page, h, d)
+    gv = v_pages[page_indices.reshape(-1)].reshape(
+        b, n_max * page, h, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   gk.astype(jnp.float32)) / np.sqrt(d)
+    ki = jnp.arange(n_max * page, dtype=jnp.int32)
+    qpos = (lengths[:, None] - t_q
+            + jnp.arange(t_q, dtype=jnp.int32)[None, :])     # [B, Tq]
+    valid = ki[None, None, :] <= qpos[:, :, None]            # [B,Tq,K]
+    s = jnp.where(valid[:, None, :, :], s, NEG_INF)
+    m = jnp.maximum(s.max(axis=-1, keepdims=True), NEG_INF / 2)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    p = p / jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, gv.astype(jnp.float32))
+    return out.astype(q.dtype)

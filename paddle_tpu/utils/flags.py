@@ -154,6 +154,24 @@ FLAGS.define("conv_bn_fuse_fwd", True,
              "instead of materializing the normalized activation in "
              "HBM; off = the exact round-6 lowering, for A/B traffic "
              "measurement")
+FLAGS.define("flash_kernel", True,
+             "run attention through the Pallas flash kernel "
+             "(ops/pallas_attention.py); off = the exact dense XLA "
+             "attention composition, for A/B traffic measurement")
+FLAGS.define("flash_block_sparse", True,
+             "block-sparse flash attention: compact the KV grid per "
+             "q-block so blocks fully above the causal diagonal or past "
+             "a row's scalar-prefetched length are neither DMA'd nor "
+             "visited (fwd + both backward kernels); off = the legacy "
+             "full (B*H, q_blocks, k_blocks) grid that fetched every "
+             "block and only skipped the compute, for one-flag revert / "
+             "A/B traffic measurement")
+FLAGS.define("attention_packing", True,
+             "sequence packing for attention layers with packed=True: "
+             "mixed-length rows share one [total_tokens] segment-id "
+             "layout where padding and cross-sequence blocks do zero "
+             "work; off = the layer ignores the packed attr and runs "
+             "the exact padded per-row lowering")
 FLAGS.define("fused_rnn_hblock", True,
              "enable the hidden-blocked fused RNN tier (ops/"
              "pallas_lstm.py, ops/pallas_gru.py): 512 < H shapes run "

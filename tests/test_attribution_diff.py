@@ -22,11 +22,15 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 OLD = os.path.join(REPO, "benchmark", "rooflines", "fc_sgd_before.json")
 NEW = os.path.join(REPO, "benchmark", "rooflines", "fc_sgd_after.json")
+ATT_OLD = os.path.join(REPO, "benchmark", "rooflines",
+                       "attn_t2048_causal_before.json")
+ATT_NEW = os.path.join(REPO, "benchmark", "rooflines",
+                       "attn_t2048_causal_after.json")
 
 
 # ------------------------------------------------------------- schema
 def test_committed_dumps_are_schema_v2():
-    for path in (OLD, NEW):
+    for path in (OLD, NEW, ATT_OLD, ATT_NEW):
         rep = costmodel.load_report(path)
         assert rep["schema"] == costmodel.SCHEMA_VERSION == 2
         assert rep["regions"] and rep["peaks"]["ridge"] > 0
@@ -171,6 +175,38 @@ def test_bench_attribution_diff_cli_replays_committed_dumps():
     assert rows["hidden"]["bytes_delta_frac"] == pytest.approx(
         -0.4, abs=1e-3)
     assert "hidden" in proc.stderr and "renamed" in proc.stderr
+
+
+def test_attention_block_sparse_dumps_pin_30pct_byte_cut(capsys):
+    """Round-19 acceptance: the committed causal-T=2048 transformer
+    dumps (benchmark/rooflines/attn_t2048_causal_*.json, regenerated
+    by make_attention_dumps.py) replay through ``bench.py
+    --attribution_diff --check`` clean, and every attention region's
+    attributed HBM bytes fell ≥30 % — block-skip vs the legacy
+    fetch-everything kernel, verified by machine, not prose."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    rc = bench.main(["--attribution_diff", ATT_OLD, ATT_NEW, "--check"])
+    assert rc == 0
+    diff = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert diff["kind"] == "attribution_diff" and diff["ok"] is True
+    rows = {r["region"]: r for r in diff["regions"]}
+    attn = [r for name, r in rows.items() if name.startswith("attn")]
+    assert len(attn) == 2, sorted(rows)
+    for r in attn:
+        assert r["status"] == "common"
+        assert r["bytes_delta_frac"] <= -0.30, r
+        # the dropped blocks were live FLOPs too (the old kernel only
+        # skipped compute above the diagonal — at 512-blocks the pair
+        # table additionally drops the partially-dead diagonal DMA)
+        assert r["flops_delta_frac"] < 0.0, r
+    assert any(i["region"].startswith("attn") and i["field"] == "bytes"
+               for i in diff["improvements"])
+    # the win must show in the step totals, not just the regions
+    assert diff["totals"]["bytes_per_step_delta_frac"] < -0.05
 
 
 def test_bench_attribution_diff_check_exits_2_on_regression(tmp_path):

@@ -260,11 +260,15 @@ def test_committed_baseline_gate_trips_on_2x_slowed_row(tmp_path):
     slowed_series = []
     for line in lines:
         for row in line.get("rows", ()):
-            for mode in ("sync", "prefetch", "fp32", "bf16"):
-                if row.get(mode, {}).get("ms_per_batch"):
-                    row[mode]["ms_per_batch"] *= 2.0
-                    slowed_series.append(
-                        f"{line['metric']}.{row['workload']}.{mode}_ms")
+            for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
+                         "legacy", "block_skip", "padded", "packed",
+                         "decode"):
+                for key in ("ms_per_batch", "ms_per_call"):
+                    if row.get(mode, {}).get(key):
+                        row[mode][key] *= 2.0
+                        slowed_series.append(
+                            f"{line['metric']}.{row['workload']}"
+                            f".{mode}_ms")
     assert slowed_series, "committed baseline has no nested timings"
     replay = str(tmp_path / "slowed.jsonl")
     with open(replay, "w") as f:

@@ -1,0 +1,472 @@
+"""Block-sparse / packed / paged-decode attention equivalence suite.
+
+Round 19 turned ``ops/pallas_attention.py`` from masked-but-fetched
+into truly block-sparse (scalar-prefetched pair tables + windowed DMA).
+These tests pin every new path against the dense reference the kill
+switches restore:
+
+- block-skip (pair-grid) forward + gradients ≡ dense across causal /
+  key-padding / rectangular / zero-length / block-boundary-length
+  cases, and ≡ the legacy full grid it replaced;
+- packed (segment-id) forward + gradients ≡ per-row dense attention on
+  valid tokens, exact zeros on padding, layer-level kill switches in
+  both directions;
+- the paged-KV decode primitive ≡ a one-step dense reference over a
+  partially-filled paged cache, with the page table actually driving
+  the gather;
+- the static pair tables: causal skip fraction, fwd/bwd same pair set
+  (the single-shared-masking-helper contract);
+- ``attention_dispatch_total{path,reason}`` trace-time counter pins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import observe
+from paddle_tpu.ops import pallas_attention as pa
+from paddle_tpu.utils import FLAGS, PaddleTpuError
+
+
+@pytest.fixture
+def attn_flags():
+    """Restore the attention dispatch flags after each test."""
+    saved = {f: FLAGS.get(f) for f in
+             ("flash_kernel", "flash_block_sparse", "attention_packing")}
+    yield
+    for f, v in saved.items():
+        FLAGS.set(f, v)
+
+
+def _qkv(rng, b, t, h=2, d=16, scale=0.5):
+    return tuple(jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+                 * scale for _ in range(3))
+
+
+def _grads(fn, q, k, v, cot):
+    return jax.grad(lambda *a: jnp.sum(fn(*a) * cot),
+                    argnums=(0, 1, 2))(q, k, v)
+
+
+def _dense_grads(q, k, v, lengths, causal, cot, segments=None):
+    """Gradients through the exact dense composition (flash off)."""
+    old = FLAGS.flash_kernel
+    FLAGS.set("flash_kernel", False)
+    try:
+        if segments is None:
+            fn = lambda *a: pa.flash_attention(*a, lengths, causal,
+                                               128, 16)
+        else:
+            fn = lambda *a: pa.flash_attention_packed(*a, segments,
+                                                      causal, 128, 16)
+        return _grads(fn, q, k, v, cot)
+    finally:
+        FLAGS.set("flash_kernel", old)
+
+
+# --------------------------------------------------------- block-skip
+# lengths hit a zero row, a block-boundary row (64 = 4 full k blocks of
+# 16), an off-boundary row and a full row — the cases where a windowed
+# DMA clamp could diverge from the mask
+LENGTH_CASES = [256, 93, 64, 0]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_matches_dense_padded(causal, rng):
+    B, T = 4, 256
+    q, k, v = _qkv(rng, B, T)
+    lengths = jnp.asarray(LENGTH_CASES, jnp.int32)
+    cot = jnp.asarray(rng.randn(*q.shape).astype(np.float32))
+
+    out = pa.flash_attention(q, k, v, lengths, causal, 128, 16)
+    ref, _ = pa._dense_forward(q, k, v, lengths, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    g = _grads(lambda *a: pa.flash_attention(*a, lengths, causal,
+                                             128, 16), q, k, v, cot)
+    gd = _dense_grads(q, k, v, lengths, causal, cot)
+    for a, b in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+    # zero-length row: zero output, zero dk/dv for its keys
+    assert np.abs(np.asarray(out)[3]).max() == 0.0
+    assert np.abs(np.asarray(g[1])[3]).max() == 0.0
+    assert np.abs(np.asarray(g[2])[3]).max() == 0.0
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_matches_legacy_grid(causal, rng, attn_flags):
+    """The compacted pair grid computes exactly what the legacy full
+    grid computed — the --flash_block_sparse kill switch is a perf
+    knob, never a numerics knob."""
+    B, T = 2, 256
+    q, k, v = _qkv(rng, B, T)
+    lengths = jnp.asarray([256, 100], jnp.int32)
+    cot = jnp.asarray(rng.randn(*q.shape).astype(np.float32))
+    fn = lambda *a: pa.flash_attention(*a, lengths, causal, 128, 16)
+
+    out_sparse = fn(q, k, v)
+    g_sparse = _grads(fn, q, k, v, cot)
+    FLAGS.set("flash_block_sparse", False)
+    out_legacy = fn(q, k, v)
+    g_legacy = _grads(fn, q, k, v, cot)
+    np.testing.assert_allclose(np.asarray(out_sparse),
+                               np.asarray(out_legacy),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(g_sparse, g_legacy):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_block_sparse_rectangular_cross(rng):
+    """Tq != Tk (cross-attention shapes) on the pair grid."""
+    B, TQ, TK = 2, 128, 256
+    q = jnp.asarray(rng.randn(B, TQ, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, TK, 2, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, TK, 2, 16).astype(np.float32))
+    lengths = jnp.asarray([256, 70], jnp.int32)
+    cot = jnp.asarray(rng.randn(*q.shape).astype(np.float32))
+    out = pa.flash_attention(q, k, v, lengths, False, 128, 16)
+    ref, _ = pa._dense_forward(q, k, v, lengths, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    g = _grads(lambda *a: pa.flash_attention(*a, lengths, False,
+                                             128, 16), q, k, v, cot)
+    gd = _dense_grads(q, k, v, lengths, False, cot)
+    for a, b in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_causal_tq_ne_tk_raises_paddle_error(rng):
+    """Satellite: the old bare ``assert`` (vanishes under python -O) is
+    now a PaddleTpuError naming the offending shapes."""
+    q = jnp.zeros((1, 32, 1, 8), jnp.float32)
+    k = jnp.zeros((1, 64, 1, 8), jnp.float32)
+    with pytest.raises(PaddleTpuError, match="32/64"):
+        pa.flash_attention(q, k, k, None, True, 32, 32)
+
+
+def test_kill_switches_and_dispatch_counter(rng, attn_flags):
+    """Every dispatch path ticks its own counter series, and the kill
+    switches actually change the path (both directions)."""
+    B, T = 2, 256
+    q, k, v = _qkv(rng, B, T)
+
+    def flat():
+        return observe.REGISTRY.flat(kinds=("counter",))
+
+    pa.flash_attention(q, k, v, None, True, 128, 16)
+    assert flat()[
+        'attention_dispatch_total{path="block_sparse",reason=""}'] >= 1
+    FLAGS.set("flash_block_sparse", False)
+    pa.flash_attention(q, k, v, None, True, 128, 16)
+    assert flat()[
+        'attention_dispatch_total{path="legacy_grid",'
+        'reason="kill_switch:flash_block_sparse"}'] >= 1
+    FLAGS.set("flash_kernel", False)
+    pa.flash_attention(q, k, v, None, True, 128, 16)
+    assert flat()[
+        'attention_dispatch_total{path="dense",'
+        'reason="kill_switch:flash_kernel"}'] >= 1
+    FLAGS.set("flash_kernel", True)
+    FLAGS.set("flash_block_sparse", True)
+    # untileable shape → dense with the untileable reason
+    qs = jnp.zeros((1, 48, 1, 8), jnp.float32)
+    pa.flash_attention(qs, qs, qs, None, False, 16, 12)
+    assert any(k_.startswith('attention_dispatch_total{path="dense",'
+                             'reason="untileable')
+               for k_ in flat())
+
+
+# -------------------------------------------------------- pair tables
+def test_pair_tables_causal_skip_fraction():
+    """Causal tables enumerate exactly the at-or-below-diagonal block
+    pairs — at T=2048 with 512 blocks that is 10 of 16 (the committed
+    roofline delta's arithmetic) — and the fwd (q-major) and bwd
+    (k-major) tables contain the SAME pair set, so forward and
+    backward sparsity cannot diverge."""
+    tab_q, tab_k = pa._pair_tables(2048, 2048, 512, 512, True)
+    assert tab_q.shape == (4, 10) and tab_k.shape == (4, 10)
+    pairs_q = set(zip(tab_q[0].tolist(), tab_q[1].tolist()))
+    pairs_k = set(zip(tab_k[0].tolist(), tab_k[1].tolist()))
+    assert pairs_q == pairs_k
+    assert pairs_q == {(j, s) for j in range(4) for s in range(4)
+                       if s <= j}
+    # every q block flushes exactly once in the q-major order; every k
+    # block flushes exactly once in the k-major order
+    assert tab_q[3].sum() == 4 and tab_q[2].sum() == 4
+    assert tab_k[3].sum() == 4 and tab_k[2].sum() == 4
+    # non-causal: full grid, no pairs dropped
+    full_q, _ = pa._pair_tables(2048, 2048, 512, 512, False)
+    assert full_q.shape == (4, 16)
+
+
+def test_segment_windows_skip_interleaved_padding():
+    """Padding-only blocks BETWEEN segments must not shift the window
+    (regression: counting 'blocks entirely before' treated the empty
+    sentinel range as before-everything)."""
+    lengths = jnp.asarray([100, 64, 30], jnp.int32)
+    seg = pa.segments_from_lengths(lengths, 3, 128)
+    lo, hi = pa._segment_windows(seg, seg, 128, 16)
+    # q blocks align with rows at bq=128: row 0 spans k blocks 0..6
+    # (100 tokens / 16), row 1 blocks 8..11, row 2 blocks 16..17
+    assert np.asarray(lo).tolist() == [[0, 8, 16]]
+    assert np.asarray(hi).tolist() == [[6, 11, 17]]
+
+
+# ------------------------------------------------------------- packed
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_matches_per_row_dense(causal, rng):
+    """Packed kernel over one [1, B·T] token axis ≡ per-row dense
+    attention on every valid token; padding tokens emit exact zeros
+    and receive exact-zero gradients."""
+    H, D = 2, 16
+    lens = [100, 64, 30]          # boundary (64 = 4·16) + odd + short
+    B, T = 3, 128
+    x = [rng.randn(B, T, H, D).astype(np.float32) for _ in range(3)]
+    q, k, v = (jnp.asarray(a.reshape(1, B * T, H, D)) for a in x)
+    seg = pa.segments_from_lengths(jnp.asarray(lens, jnp.int32), B, T)
+    out = np.asarray(pa.flash_attention_packed(q, k, v, seg, causal,
+                                               128, 16))
+    out = out.reshape(B, T, H, D)
+    ref = np.asarray(pa._dense_forward(
+        jnp.asarray(x[0]), jnp.asarray(x[1]), jnp.asarray(x[2]),
+        jnp.asarray(lens, jnp.int32), causal)[0])
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(out[i, :l], ref[i, :l],
+                                   rtol=2e-4, atol=2e-5)
+        assert np.abs(out[i, l:]).max() == 0.0
+    cot = jnp.asarray(rng.randn(1, B * T, H, D).astype(np.float32))
+    g = _grads(lambda *a: pa.flash_attention_packed(
+        *a, seg, causal, 128, 16), q, k, v, cot)
+    gd = _dense_grads(q, k, v, None, causal, cot, segments=seg)
+    segn = np.asarray(seg).reshape(B * T)
+    for a, b in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+        assert np.abs(np.asarray(a)[0, segn < 0]).max() == 0.0
+
+
+def test_packed_layer_kill_switch_both_directions(rng, attn_flags):
+    """Layer plumbing: packed=True equals the padded lowering on valid
+    tokens; --attention_packing=false makes the packed layer EXACTLY
+    the padded layer (byte-for-byte same path)."""
+    from layer_grad_util import build_single_layer_net
+    from paddle_tpu.core.sequence import pad_batch
+
+    lens = [100, 64, 30]
+    sb = pad_batch([rng.randn(l, 12).astype(np.float32) for l in lens],
+                   max_len=128)
+    mk = lambda packed: build_single_layer_net(
+        "scaled_dot_product_attention", size=16, input_sizes=[12],
+        with_bias=True, attrs={"num_heads": 4, "causal": True,
+                               "block_q": 128, "block_k": 16,
+                               "packed": packed})
+    net_pad, net_pk = mk(False), mk(True)
+    params = net_pad.init_params(seed=2)
+    o_pad = np.asarray(net_pad.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    o_pk = np.asarray(net_pk.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(o_pk[i, :l], o_pad[i, :l],
+                                   rtol=2e-4, atol=2e-5)
+    FLAGS.set("attention_packing", False)
+    o_off = np.asarray(net_pk.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    np.testing.assert_array_equal(o_off, o_pad)
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="unpacked",'
+                'reason="kill_switch:attention_packing"}'] >= 1
+    FLAGS.set("attention_packing", True)
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="packed",reason=""}'] \
+        >= 1
+
+
+def test_packed_zero_length_row(rng):
+    """A zero-length sequence inside a packed batch contributes nothing
+    and breaks nothing."""
+    lens = [60, 0, 31]
+    B, T, H, D = 3, 64, 2, 16
+    x = [rng.randn(B, T, H, D).astype(np.float32) for _ in range(3)]
+    q, k, v = (jnp.asarray(a.reshape(1, B * T, H, D)) for a in x)
+    seg = pa.segments_from_lengths(jnp.asarray(lens, jnp.int32), B, T)
+    out = np.asarray(pa.flash_attention_packed(q, k, v, seg, False,
+                                               128, 16))
+    out = out.reshape(B, T, H, D)
+    ref = np.asarray(pa._dense_forward(
+        jnp.asarray(x[0]), jnp.asarray(x[1]), jnp.asarray(x[2]),
+        jnp.asarray(lens, jnp.int32), False)[0])
+    assert np.abs(out[1]).max() == 0.0
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(out[i, :l], ref[i, :l],
+                                   rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------------------- decode
+@pytest.mark.parametrize("t_q", [1, 4])
+def test_paged_decode_matches_dense_reference(t_q, rng):
+    """The decode primitive over a partially-filled paged cache equals
+    the dense one-step reference: per-row lengths (mid-page fills),
+    per-row page tables, small-Tq causal tail."""
+    B, H, D = 3, 2, 16
+    P, page, n_max = 10, 16, 4
+    kpg = jnp.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    vpg = jnp.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    pidx = jnp.asarray([[2, 0, 4, 7], [5, 1, 3, 8], [9, 6, 2, 0]],
+                       jnp.int32)
+    # mid-page, page-boundary, and single-page fills
+    lengths = jnp.asarray([55, 32, 7], jnp.int32)
+    q = jnp.asarray(rng.randn(B, t_q, H, D).astype(np.float32))
+    out = pa.paged_decode_attention(q, kpg, vpg, pidx, lengths)
+    ref = pa.paged_decode_reference(q, kpg, vpg, pidx, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="decode",reason=""}'] \
+        >= 1
+
+
+def test_paged_decode_fully_masked_rows_emit_zeros(rng):
+    """0 < length < Tq (speculative/chunked decode on a near-empty
+    row): the leading query rows sit at negative positions and are
+    fully masked — they must emit exact zeros like the reference, not
+    an exp(−inf − (−inf)) = 1 average of V (regression: the decode
+    kernel lacked the pair kernel's exponent-base clamp)."""
+    B, t_q, H, D = 2, 4, 2, 16
+    P, page = 6, 16
+    kpg = jnp.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    vpg = jnp.asarray(rng.randn(P, page, H, D).astype(np.float32))
+    pidx = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    lengths = jnp.asarray([2, 12], jnp.int32)   # row 0: 2 of 4 queries live
+    q = jnp.asarray(rng.randn(B, t_q, H, D).astype(np.float32))
+    out = pa.paged_decode_attention(q, kpg, vpg, pidx, lengths)
+    ref = pa.paged_decode_reference(q, kpg, vpg, pidx, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # row 0's queries 0..1 are at positions −2/−1: exact zeros
+    assert np.abs(np.asarray(out)[0, :2]).max() == 0.0
+
+
+def test_packed_layer_block_sparse_kill_switch_reverts_to_padded(
+        rng, attn_flags):
+    """--flash_block_sparse=false on a packed layer reverts to the
+    padded per-row lowering (regression: the op-level fallback built a
+    dense [1, B·T]² score matrix — O((B·T)²) memory at bench scale)."""
+    from layer_grad_util import build_single_layer_net
+    from paddle_tpu.core.sequence import pad_batch
+
+    lens = [100, 30]
+    sb = pad_batch([rng.randn(l, 12).astype(np.float32) for l in lens],
+                   max_len=128)
+    mk = lambda packed: build_single_layer_net(
+        "scaled_dot_product_attention", size=16, input_sizes=[12],
+        attrs={"num_heads": 4, "block_q": 128, "block_k": 16,
+               "packed": packed})
+    net_pad, net_pk = mk(False), mk(True)
+    params = net_pad.init_params(seed=2)
+    FLAGS.set("flash_block_sparse", False)
+    o_pad = np.asarray(net_pad.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    o_pk = np.asarray(net_pk.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    np.testing.assert_array_equal(o_pk, o_pad)   # same (legacy) path
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="unpacked",'
+                'reason="kill_switch:flash_block_sparse(packed)"}'] >= 1
+    # no packed series, no dense fallback ticked for the packed layer
+    assert 'attention_dispatch_total{path="packed",reason=""}' \
+        not in flat
+
+
+def test_packed_layer_untileable_flatten_reverts_to_padded(rng):
+    """A flatten whose blocks miss the Pallas tiling gate must revert
+    to the padded per-row lowering at the LAYER (regression: the
+    op-level fallback would run dense attention over the flattened
+    [1, B·T] axis — an O((B·T)²) score matrix at scale)."""
+    from layer_grad_util import build_single_layer_net
+    from paddle_tpu.core.sequence import pad_batch
+
+    # T=500, B=4: flat total 2000, _choose_block(2000, 500) = 500 —
+    # neither %128 nor the full axis → untileable
+    sb = pad_batch([rng.randn(l, 12).astype(np.float32)
+                    for l in (500, 300, 200, 100)], max_len=500)
+    mk = lambda packed: build_single_layer_net(
+        "scaled_dot_product_attention", size=16, input_sizes=[12],
+        attrs={"num_heads": 4, "packed": packed})
+    net_pad, net_pk = mk(False), mk(True)
+    params = net_pad.init_params(seed=2)
+    o_pad = np.asarray(net_pad.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    o_pk = np.asarray(net_pk.forward(
+        params, {"in0": sb}, is_training=False)[0]["test"].data)
+    np.testing.assert_array_equal(o_pk, o_pad)   # same path entirely
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="unpacked",'
+                'reason="untileable(packed flatten)"}'] >= 1
+    assert 'attention_dispatch_total{path="packed",reason=""}' \
+        not in flat
+
+
+def test_packed_slot_hint_degradation_is_recorded(rng):
+    """A slot width that is not a whole number of blocks cannot drop
+    cross-slot pairs; the degradation must be visible (dispatch reason
+    + one-time warning), not silent."""
+    T, H, D = 256, 2, 16
+    q = jnp.asarray(rng.randn(1, T, H, D).astype(np.float32))
+    seg = pa.segments_from_lengths(jnp.asarray([100, 80], jnp.int32),
+                                   2, 128)
+    pa.flash_attention_packed(q, q, q, seg, False, 128, 16, 100)
+    flat = observe.REGISTRY.flat(kinds=("counter",))
+    assert flat['attention_dispatch_total{path="packed",reason="slot '
+                'hint unusable (blocks straddle slots)"}'] >= 1
+
+
+def test_paged_decode_page_table_drives_gather(rng):
+    """Permuting physical pages while permuting the table the same way
+    must not change the result — the scalar-prefetched indices really
+    address the pages."""
+    B, H, D = 1, 2, 16
+    P, page, n_max = 6, 16, 3
+    kpg = rng.randn(P, page, H, D).astype(np.float32)
+    vpg = rng.randn(P, page, H, D).astype(np.float32)
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+    lengths = jnp.asarray([40], jnp.int32)
+    pidx = np.asarray([[1, 3, 5]], np.int32)
+    out1 = pa.paged_decode_attention(
+        q, jnp.asarray(kpg), jnp.asarray(vpg), jnp.asarray(pidx),
+        lengths)
+    perm = np.asarray([4, 0, 3, 2, 5, 1])      # old page p → slot
+    inv = np.argsort(perm)
+    out2 = pa.paged_decode_attention(
+        q, jnp.asarray(kpg[inv]), jnp.asarray(vpg[inv]),
+        jnp.asarray(perm[pidx]), lengths)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_paged_decode_ignores_stale_pages(rng):
+    """Cache slots past the row's length — including whole unused table
+    entries — must not influence the output."""
+    B, H, D = 1, 2, 8
+    P, page = 4, 16
+    kpg = rng.randn(P, page, H, D).astype(np.float32)
+    vpg = rng.randn(P, page, H, D).astype(np.float32)
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32))
+    pidx = jnp.asarray([[0, 1, 2]], jnp.int32)
+    lengths = jnp.asarray([20], jnp.int32)     # page 1 half full
+    out1 = pa.paged_decode_attention(
+        q, jnp.asarray(kpg), jnp.asarray(vpg), pidx, lengths)
+    kpg2, vpg2 = kpg.copy(), vpg.copy()
+    kpg2[1, 4:] = 99.0                          # beyond length
+    vpg2[1, 4:] = -99.0
+    kpg2[2] = 77.0                              # wholly-unused page
+    vpg2[2] = -77.0
+    kpg2[3] = 55.0                              # not in the table
+    out2 = pa.paged_decode_attention(
+        q, jnp.asarray(kpg2), jnp.asarray(vpg2), pidx, lengths)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               rtol=1e-6, atol=1e-7)
