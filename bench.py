@@ -770,6 +770,197 @@ def bench_attention():
             FLAGS.set(k, v)
 
 
+# --serving_small: CPU-runnable decoder shapes for the serving lane
+SERVING_SMALL = False
+
+
+def _serving_shapes():
+    """(cfg, n_requests, prompt_len_range, max_new, max_batch,
+    pool_pages, page_size, timed_passes) for the serving lane."""
+    from paddle_tpu.serving.model import DecoderConfig
+
+    if SERVING_SMALL:
+        return (DecoderConfig(vocab=512, dim=64, heads=4, layers=2,
+                              ffn=128, max_context=128, eos_id=1),
+                12, (4, 24), 8, 4, 64, 16, 2)
+    return (DecoderConfig(vocab=4000, dim=256, heads=8, layers=4,
+                          ffn=1024, max_context=512, eos_id=1),
+            48, (16, 96), 32, 8, 512, 16, 3)
+
+
+def _serving_mode_run(model, prompts, max_new, max_batch, pool_pages,
+                      page, continuous, passes):
+    """Drive the full request stream through one
+    :class:`~paddle_tpu.serving.server.InferenceServer` mode.  One
+    untimed pass pays the per-(B, T)-bucket XLA compiles; each timed
+    pass submits every request up front (open loop) and waits them all
+    out — sustained req/s is completions over wall, TTFT lands in a
+    bench-owned reservoir histogram (the p99 the SLO gate reads).
+    Returns (mode summary dict, per-pass req/s list, generated tokens
+    of the last pass — the kill-switch equality witness)."""
+    from paddle_tpu.serving.server import InferenceServer
+
+    mode = "continuous" if continuous else "sequential"
+    hist = observe.histogram(
+        "bench_serve_ttft_seconds",
+        "serving-lane submit-to-first-token reservoir, by mode")
+    lat = observe.histogram(
+        "bench_serve_latency_seconds",
+        "serving-lane submit-to-last-token reservoir, by mode")
+    srv = InferenceServer(model, max_batch=max_batch, n_pages=pool_pages,
+                          page_size=page, continuous=continuous).start()
+    try:
+        for r in [srv.submit(p, max_new) for p in prompts]:  # warm pass
+            srv.result(r, timeout=600.0)
+        walls, tokens = [], None
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            reqs = [srv.submit(p, max_new) for p in prompts]
+            tokens = [srv.result(r, timeout=600.0) for r in reqs]
+            walls.append(time.perf_counter() - t0)
+            for r in reqs:
+                hist.observe(r.ttft_s, mode=mode)
+                lat.observe(r.latency_s, mode=mode)
+    finally:
+        srv.stop()
+    rps = [len(prompts) / w for w in walls]
+    return {
+        "req_per_sec": round(float(np.median(rps)), 3),
+        "p99_ms": round(hist.sample_quantile(0.99, mode=mode) * 1e3, 3),
+        "p50_ttft_ms": round(
+            hist.sample_quantile(0.5, mode=mode) * 1e3, 3),
+        "p99_latency_ms": round(
+            lat.sample_quantile(0.99, mode=mode) * 1e3, 3),
+    }, rps, tokens
+
+
+def bench_serving():
+    """Serving lane (`--only serving`, round 20): sustained req/s of the
+    continuous-batching :class:`InferenceServer` vs the same loop with
+    the ``--serve_continuous=false`` kill switch (sequential
+    single-request serving) — the machine-checked A/B the baseline
+    gate replays.  One deterministic mixed-length request stream runs
+    through BOTH modes; the lane also asserts the two modes generated
+    byte-identical tokens (the kill-switch contract), so the perf
+    number and the correctness witness travel on one line.
+
+    Headline value: continuous-mode req/s.  ``p99_ms`` per mode is the
+    submit-to-first-token p99 read from a reservoir histogram
+    (``Histogram.sample_quantile`` — the SLO sensor); with
+    ``--serve_slo_ms > 0`` the line records whether the p99 met it.
+    The observatory stamp is trainer-free: region attribution via
+    ``costmodel.analyze_fn`` on the jitted decode step, HBM census via
+    ``observe.memory.sample`` over the live params + KV pools."""
+    import types as _types
+
+    from paddle_tpu.serving.model import (DecoderModel, _decode_impl,
+                                          init_decoder_params)
+
+    cfg, n_req, (lo, hi), max_new, max_batch, pool_pages, page, passes \
+        = _serving_shapes()
+    model = DecoderModel(init_decoder_params(cfg, seed=0), cfg)
+    rng = np.random.RandomState(0)
+    # token ids start at 2: never the eos id, so prompt content cannot
+    # end a request early — only generation (identical in both modes)
+    prompts = [rng.randint(2, cfg.vocab,
+                           rng.randint(lo, hi + 1)).tolist()
+               for _ in range(n_req)]
+
+    cont, cont_rps, cont_tokens = _serving_mode_run(
+        model, prompts, max_new, max_batch, pool_pages, page,
+        continuous=True, passes=passes)
+    seq, seq_rps, seq_tokens = _serving_mode_run(
+        model, prompts, max_new, max_batch, pool_pages, page,
+        continuous=False, passes=passes)
+    if cont_tokens != seq_tokens:
+        raise RuntimeError(
+            "serving kill-switch contract violated: continuous and "
+            "sequential modes generated different tokens")
+
+    r = _with_band({
+        "metric": "serving_req_per_sec",
+        "value": cont["req_per_sec"],
+        "unit": f"req/s ({n_req} mixed prompts T in [{lo},{hi}], "
+                f"max_new={max_new}, batch={max_batch}, "
+                f"{cfg.layers}L/{cfg.heads}H d={cfg.dim})",
+        "devices": 1,
+        "scale": "small" if SERVING_SMALL else "bench",
+        "rows": [{"workload": "mixed_prompts",
+                  "continuous": cont, "sequential": seq}],
+        "continuous_speedup": round(
+            cont["req_per_sec"] / max(seq["req_per_sec"], 1e-9), 3),
+        "tokens_equal": True,
+        "vs_baseline_note": "reference ships a C inference API, no "
+                            "request-serving loop; sequential mode is "
+                            "the internal yardstick",
+    }, values=cont_rps)
+    slo_ms = float(FLAGS.get("serve_slo_ms"))
+    if slo_ms > 0:
+        r["slo_ms"] = slo_ms
+        r["slo_met"] = bool(cont["p99_ms"] <= slo_ms)
+
+    # ---- trainer-free observatory stamp: attribute ONE decode step at
+    # the serving batch width (the loop's steady-state program)
+    k_pool, v_pool = model.new_pools(pool_pages, page)
+    max_pages = min(pool_pages - 1,
+                    (cfg.max_context + page - 1) // page)
+    b = max_batch
+    sargs = (model.params, k_pool, v_pool,
+             jax.numpy.zeros((b,), jax.numpy.int32),
+             jax.numpy.ones((b, max_pages), jax.numpy.int32),
+             jax.numpy.full((b,), page, jax.numpy.int32),
+             jax.numpy.ones((b,), bool))
+
+    def _step(p, kp, vp, tk, pi, ln, ac):
+        with jax.named_scope("decode_step"):
+            return _decode_impl(p, kp, vp, tk, pi, ln, ac, cfg)
+
+    report = costmodel.analyze_fn(_step, sargs, known=["decode_step"],
+                                  cache_key="serving-decode")
+    if report is not None:
+        r["hbm_gb_per_step"] = round(report["xla_bytes"] / 1e9, 2) \
+            if report["xla_bytes"] else None
+        r["regions"] = report["regions"]
+        r["regions_elided"] = report["regions_elided"]
+        r["flop_agreement"] = report["flop_agreement"]
+        if report["opaque_custom_calls"]:
+            r["opaque_custom_calls"] = report["opaque_custom_calls"]
+    else:
+        r["hbm_gb_per_step"] = None
+        r["regions"] = None
+    snap = omem.sample(_types.SimpleNamespace(params=model.params),
+                       {"k_pool": k_pool, "v_pool": v_pool})
+    r["hbm_peak_bytes"] = snap["peak_bytes"]
+    r["hbm_in_use_bytes"] = snap["in_use_bytes"]
+    r["hbm_categories"] = snap["categories"]
+    # MFU of the decode step itself (timed directly — wall req/s mixes
+    # scheduling with math; MFU is about the math).  The paged kernels
+    # are opaque custom calls, so the analytic matmul count is the
+    # usual fallback, exactly as step_mfu decides for training lanes.
+    step_j = jax.jit(_step)
+    jax.block_until_ready(step_j(*sargs))
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step_j(*sargs))
+        times.append(time.perf_counter() - t0)
+    step_s = float(np.median(times))
+    d = cfg.dim
+    hint = 2.0 * b * (cfg.layers * (4 * d * d + 2 * d * cfg.ffn)
+                      + d * cfg.vocab)
+    flops, source = 0.0, "costmodel"
+    if report is not None:
+        flops = report["flops_per_step"]
+    if report is None or (report["opaque_custom_calls"]
+                          and hint > flops):
+        flops, source = hint, "analytic-fallback"
+    r["mfu_est"] = round(costmodel.mfu(flops, step_s, 1), 3)
+    r["mfu_source"] = source
+    r["flops_per_step"] = round(flops, 1)
+    r["decode_step_ms"] = round(step_s * 1e3, 3)
+    return r
+
+
 # --pipeline_small: CPU-runnable shapes for the prefetch A/B lane
 PIPELINE_SMALL = False
 
@@ -1466,7 +1657,7 @@ def main(argv=None):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     lanes = ["lstm", "resnet", "seq2seq", "attention", "lstm1280",
-             "lstm2048", "pipeline", "precision", "observe"]
+             "lstm2048", "pipeline", "precision", "observe", "serving"]
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     help="run a subset of lanes (comma-separated): "
@@ -1486,6 +1677,11 @@ def main(argv=None):
                          "records scale='small'); default is the bench "
                          "T=2048 scale, where the dense mode is "
                          "skipped ([T,T] scores do not fit)")
+    ap.add_argument("--serving_small", action="store_true",
+                    help="run the serving continuous-vs-sequential A/B "
+                         "lane with a CPU-sized decoder (the JSON line "
+                         "records scale='small'); default is bench "
+                         "scale")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace of a few production "
                          "train steps per workload (see --profile_dir); "
@@ -1556,6 +1752,9 @@ def main(argv=None):
     if args.attention_small:
         global ATTENTION_SMALL
         ATTENTION_SMALL = True
+    if args.serving_small:
+        global SERVING_SMALL
+        SERVING_SMALL = True
     if args.attribution_diff:
         # pure-host replay of two committed dumps: no workload runs, no
         # backend touched — the kernel-PR verification loop stays fast
@@ -1584,7 +1783,8 @@ def main(argv=None):
                    "lstm2048": bench_lstm_2048,
                    "pipeline": bench_pipeline,
                    "precision": bench_precision,
-                   "observe": bench_observe}
+                   "observe": bench_observe,
+                   "serving": bench_serving}
         order = [t.strip() for t in args.only.split(",") if t.strip()] \
             if args.only else lanes
         unknown = [t for t in order if t not in benches]
@@ -1609,7 +1809,8 @@ def main(argv=None):
             args.write_baseline, lines,
             meta={"scale": ("small" if PIPELINE_SMALL
                             or PRECISION_SMALL
-                            or ATTENTION_SMALL else "bench"),
+                            or ATTENTION_SMALL
+                            or SERVING_SMALL else "bench"),
                   "argv": sys.argv[1:] if argv is None else list(argv)})
         print(f"wrote baseline {args.write_baseline} "
               f"({len(doc['series'])} series)", file=sys.stderr,

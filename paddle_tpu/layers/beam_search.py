@@ -27,6 +27,19 @@ from .recurrent_group import RecurrentGroup
 NEG_INF = -1e9
 
 
+def eos_frozen_logits(logp: jax.Array, alive: jax.Array,
+                      eos_id: int) -> jax.Array:
+    """Freeze finished rows: a row whose ``alive`` flag dropped may only
+    continue with EOS at zero cost.  ``logp`` is ``[..., V]``, ``alive``
+    its leading shape.  Shared by the beam decoder (closed beams) and
+    the serving decode loop (finished / padded batch slots must sample
+    EOS deterministically, never garbage from an inactive row)."""
+    vocab = logp.shape[-1]
+    eos_only = jnp.full((vocab,), NEG_INF,
+                        logp.dtype).at[eos_id].set(0.0)
+    return jnp.where(alive[..., None], logp, eos_only)
+
+
 class BeamSearchDecoder:
     """Executes a generating SubModelConfig."""
 
@@ -111,8 +124,7 @@ class BeamSearchDecoder:
             if drop is not None:
                 logp = jnp.where(drop(logp, tokens, t), NEG_INF, logp)
             # finished beams may only continue with EOS at zero cost
-            eos_only = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
-            logp = jnp.where(alive[:, :, None], logp, eos_only)
+            logp = eos_frozen_logits(logp, alive, eos_id)
             cand = scores[:, :, None] + logp                # [B, K, V]
             top_scores, top_idx = jax.lax.top_k(
                 cand.reshape(b, k * vocab), k)              # [B, K]

@@ -85,22 +85,29 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     # one workload" regression actually lives (the headline of the
     # pipeline lane is a bounded ratio that would never see it).
     # Modes: pipeline sync/prefetch, precision fp32/bf16, attention
-    # dense/legacy/block-skip + padded/packed + paged decode.
+    # dense/legacy/block-skip + padded/packed + paged decode, serving
+    # continuous/sequential.
     for row in line.get("rows", ()):
         tag = row.get("workload", "?")
         for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
                      "legacy", "block_skip", "padded", "packed",
-                     "decode"):
+                     "decode", "continuous", "sequential"):
             sub = row.get(mode) or {}
-            for key, unit in (("ms_per_batch", "ms/batch"),
-                              ("ms_per_call", "ms/call")):
-                ms = sub.get(key)
-                if ms is not None:
-                    out[f"{metric}.{tag}.{mode}_ms"] = {
-                        "value": float(ms), "spread": spread,
-                        "direction": "lower", "unit": unit}
-                    break   # one series per mode: a dict carrying both
-                    # keys must not overwrite ms/batch with ms/call
+            for key, unit, direction, suffix in (
+                    ("ms_per_batch", "ms/batch", "lower", "_ms"),
+                    ("ms_per_call", "ms/call", "lower", "_ms"),
+                    # serving lane: sustained throughput gates
+                    # higher-better, the p99 tail lower-better
+                    ("req_per_sec", "req/s", "higher", "_req_per_sec"),
+                    ("p99_ms", "ms", "lower", "_p99_ms")):
+                v = sub.get(key)
+                if v is not None:
+                    out[f"{metric}.{tag}.{mode}{suffix}"] = {
+                        "value": float(v), "spread": spread,
+                        "direction": direction, "unit": unit}
+                    if suffix == "_ms":
+                        break  # one _ms series per mode: a dict with
+                        # both keys must not overwrite ms/batch
     return out
 
 

@@ -431,6 +431,24 @@ def attribute(text: str, known: Iterable[str] = ()) -> Dict[str, Any]:
     # scan overhead should land in THAT layer's region, not in
     # _unattributed
     comp_fallback: Dict[str, str] = {entry.name: "_unattributed"}
+    # second-chance fallback: XLA's loop-optimization passes (double
+    # buffering, "wide" region cloning) synthesize `while` instructions
+    # with NO op_name of their own, so the site tells us nothing — but
+    # the body's surviving instructions still carry their scopes.  Each
+    # computation votes with its resolvable op_names; a callee reached
+    # through an unattributed site inherits its own majority region
+    # (the paged decode kernel's per-page DMA loop is the motivating
+    # case: 512 trips of pool-carry copies must land in attn_decode,
+    # not smear the report with phantom _unattributed terabytes).
+    dominant: Dict[str, str] = {}
+    for comp in comps.values():
+        votes: Dict[str, float] = {}
+        for instr in comp.instrs:
+            region, _ = _region_of(instr.op_name, known)
+            if region != "_unattributed":
+                votes[region] = votes.get(region, 0.0) + 1.0
+        if votes:
+            dominant[comp.name] = max(votes, key=lambda k: votes[k])
     while_trips: Dict[str, int] = {}
     stack = [entry.name]
     seen_edges = set()
@@ -465,7 +483,9 @@ def attribute(text: str, known: Iterable[str] = ()) -> Dict[str, Any]:
                     continue
                 if kernel:
                     kernel_level.add(tgt)
-                comp_fallback.setdefault(tgt, site_region)
+                comp_fallback.setdefault(
+                    tgt, site_region if site_region != "_unattributed"
+                    else dominant.get(tgt, "_unattributed"))
                 edge = (cname, tgt)
                 mult[tgt] = mult.get(tgt, 0.0) \
                     + mult.get(cname, 1.0) * factor
@@ -699,12 +719,9 @@ def analyze_trainer_step(trainer, feed, top: int = 12,
             trainer.train_one_batch(feed)
         compiled = trainer._train_step.lower(
             *_step_args(trainer, feed)).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        ca = ca or {}
-        report = attribute(compiled.as_text(),
-                           _known_regions(trainer.network))
+        return _report_from_compiled(
+            compiled, _known_regions(trainer.network), top, peaks,
+            cache_key)
     except Exception as e:   # noqa: BLE001 — best-effort artifact field
         from ..utils.logger import get_logger, warn_once
 
@@ -712,6 +729,52 @@ def analyze_trainer_step(trainer, feed, top: int = 12,
                   "train-step cost attribution unavailable (%s: %s)",
                   type(e).__name__, e, logger=get_logger("observe"))
         return None
+
+
+def analyze_fn(fn, args: Sequence[Any], known: Iterable[str] = (),
+               top: int = 12, peaks: Optional[Dict[str, Any]] = None,
+               cache_key: Optional[str] = None
+               ) -> Optional[Dict[str, Any]]:
+    """Attributed cost report of an arbitrary jitted callable — the
+    trainer-free sibling of :func:`analyze_trainer_step` (same report
+    dict, same schema), for inference paths like the serving decode
+    step where there is no trainer to lower.  ``fn`` is jitted if it
+    is not already; ``known`` are the ``jax.named_scope`` names to
+    resolve regions against.  Returns None when the stack declines —
+    a report is an artifact field, never a crash."""
+    global _latest_report
+    if cache_key is not None and cache_key in _ANALYSIS_CACHE:
+        _latest_report = _ANALYSIS_CACHE[cache_key]
+        return _latest_report
+    try:
+        import jax
+
+        jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jfn.lower(*args).compile()
+        return _report_from_compiled(compiled, frozenset(known), top,
+                                     peaks, cache_key)
+    except Exception as e:   # noqa: BLE001 — best-effort artifact field
+        from ..utils.logger import get_logger, warn_once
+
+        warn_once("costmodel_analyze_fn_failed",
+                  "fn cost attribution unavailable (%s: %s)",
+                  type(e).__name__, e, logger=get_logger("observe"))
+        return None
+
+
+def _report_from_compiled(compiled, known: frozenset, top: int,
+                          peaks: Optional[Dict[str, Any]],
+                          cache_key: Optional[str]) -> Dict[str, Any]:
+    """Shared back half of :func:`analyze_trainer_step` /
+    :func:`analyze_fn`: optimized-HLO attribution reconciled against
+    ``cost_analysis()``, rendered as the versioned per-region roofline
+    report."""
+    global _latest_report
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    report = attribute(compiled.as_text(), known)
 
     peaks = peaks or detect_peaks()
     xla_flops = float(ca.get("flops", 0.0) or 0.0)

@@ -1330,3 +1330,51 @@ def paged_decode_reference(q, k_pages, v_pages, page_indices, lengths):
     p = p / jnp.where(l == 0.0, 1.0, l)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, gv.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_kv_write(k_pages, v_pages, k_new, v_new, page_indices,
+                   start_positions, counts):
+    """Scatter new K/V tokens into their rows' physical pages — the
+    pool-maintenance half of the paged-decode contract ("the current
+    step's K/V must already be written to the pages").
+
+    - ``k_new`` / ``v_new``: ``[B, Tn, H, D]`` — each row's newest
+      ``Tn`` tokens (``Tn`` = padded prompt length at prefill, 1 per
+      decode step);
+    - ``page_indices``: int32 ``[B, max_pages]`` per-row page table;
+    - ``start_positions``: int32 ``[B]`` absolute position of each
+      row's FIRST new token (token ``j`` of row ``b`` lands at
+      ``start_positions[b] + j``);
+    - ``counts``: int32 ``[B]`` valid new tokens per row — tokens at or
+      past the count (prompt padding; inactive batch slots via
+      ``counts == 0``) are dropped, not written.
+
+    Returns the updated ``(k_pages, v_pages)``.  Pure jnp scatter (one
+    ``.at[].set`` per pool, out-of-range destinations dropped) so XLA
+    aliases the update in place when the caller donates the pools.
+    """
+    n_pages, page, h, d = k_pages.shape
+    b, t_n = k_new.shape[0], k_new.shape[1]
+    enforce(v_new.shape == k_new.shape,
+            f"k_new/v_new shapes differ: {k_new.shape} vs {v_new.shape}")
+    enforce(page_indices.shape[0] == b
+            and start_positions.shape == (b,) and counts.shape == (b,),
+            f"paged_kv_write batch mismatch: page_indices "
+            f"{page_indices.shape}, start_positions "
+            f"{start_positions.shape}, counts {counts.shape} vs B={b}")
+    pos = start_positions.astype(jnp.int32)[:, None] \
+        + jnp.arange(t_n, dtype=jnp.int32)[None, :]          # [B, Tn]
+    slot = jnp.clip(pos // page, 0, page_indices.shape[1] - 1)
+    phys = jnp.take_along_axis(page_indices.astype(jnp.int32), slot,
+                               axis=1)                       # [B, Tn]
+    dest = phys * page + pos % page
+    valid = (jnp.arange(t_n, dtype=jnp.int32)[None, :]
+             < counts.astype(jnp.int32)[:, None]) & (pos >= 0)
+    # invalid tokens aim past the pool; mode="drop" discards them
+    dest = jnp.where(valid, dest, n_pages * page).reshape(-1)
+    kf = k_pages.reshape(n_pages * page, h, d).at[dest].set(
+        k_new.reshape(b * t_n, h, d).astype(k_pages.dtype), mode="drop")
+    vf = v_pages.reshape(n_pages * page, h, d).at[dest].set(
+        v_new.reshape(b * t_n, h, d).astype(v_pages.dtype), mode="drop")
+    return (kf.reshape(n_pages, page, h, d),
+            vf.reshape(n_pages, page, h, d))

@@ -110,6 +110,38 @@ def _quantizable(arr: np.ndarray) -> bool:
     return arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating)
 
 
+def quantize_weight_store(params: Dict[str, Any], dequant_dtype: str
+                          ) -> Tuple[Dict[str, np.ndarray],
+                                     List[Dict[str, Any]]]:
+    """Build the version-2 ``weights.npz`` store + manifest entries for
+    a parameter dict: quantizable tensors as ``q::name`` / ``s::name``
+    (int8 + per-channel scales, dequantized to ``dequant_dtype`` at
+    load), the rest raw as ``w::name``.  Entry order follows sorted
+    names — the load order contract of ``loader.load_weight_entries``.
+    Shared by the network int8 export and the decoder-artifact export
+    (``serving/model.py``)."""
+    deq_dt = np_dtype(dequant_dtype)
+    store: Dict[str, np.ndarray] = {}
+    entries: List[Dict[str, Any]] = []
+    for name in sorted(params):
+        arr = np.asarray(params[name])
+        if _quantizable(arr):
+            q, scale = quantize_int8(arr, axis=-1)
+            store["q::" + name] = q
+            store["s::" + name] = scale
+            entries.append({"name": name, "shape": list(arr.shape),
+                            "dtype": dtype_name(deq_dt),
+                            "quantized": True, "axis": -1})
+        else:
+            raw = arr.astype(np.float32) \
+                if np.issubdtype(arr.dtype, np.floating) else arr
+            store["w::" + name] = raw
+            entries.append({"name": name, "shape": list(arr.shape),
+                            "dtype": dtype_name(raw.dtype),
+                            "quantized": False, "axis": None})
+    return store, entries
+
+
 def _feed_arg_specs(examples: Dict[str, np.ndarray],
                     feed_names: Sequence[str], poly: bool):
     if not poly:
@@ -281,28 +313,9 @@ def _export_network_int8(fwd, params, flat_examples, dirname,
     examples = {k: np.asarray(flat_examples[k]) for k in feed_names}
     deq_dt = np_dtype(dequant_dtype)
 
-    store: Dict[str, np.ndarray] = {}
-    entries: List[Dict[str, Any]] = []
-    warg_specs = []
-    for name in wnames:
-        arr = np.asarray(params[name])
-        if _quantizable(arr):
-            q, scale = quantize_int8(arr, axis=-1)
-            store["q::" + name] = q
-            store["s::" + name] = scale
-            arg_dt = deq_dt
-            entries.append({"name": name, "shape": list(arr.shape),
-                            "dtype": dtype_name(arg_dt),
-                            "quantized": True, "axis": -1})
-        else:
-            raw = arr.astype(np.float32) \
-                if np.issubdtype(arr.dtype, np.floating) else arr
-            store["w::" + name] = raw
-            arg_dt = raw.dtype
-            entries.append({"name": name, "shape": list(arr.shape),
-                            "dtype": dtype_name(arg_dt),
-                            "quantized": False, "axis": None})
-        warg_specs.append(jax.ShapeDtypeStruct(arr.shape, arg_dt))
+    store, entries = quantize_weight_store(params, dequant_dtype)
+    warg_specs = [jax.ShapeDtypeStruct(tuple(e["shape"]), np_dtype(e["dtype"]))
+                  for e in entries]
 
     nw = len(wnames)
 

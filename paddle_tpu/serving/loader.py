@@ -55,10 +55,46 @@ def _dequantize(q: np.ndarray, scale: np.ndarray, axis: int,
 # serving process that ships just this file (the capi-style deployment
 # story) runs fine without it
 try:
-    from ..observe import counter as _counter, histogram as _histogram
+    from ..observe import counter as _counter, gauge as _gauge
+    from ..observe import histogram as _histogram
     from ..observe import fleet as _fleet, trace as _trace
 except ImportError:  # standalone copy: no package context
-    _counter = _histogram = _trace = _fleet = None
+    _counter = _gauge = _histogram = _trace = _fleet = None
+
+
+def read_manifest(dirname: str, max_version: int = 2) -> Dict[str, Any]:
+    """Read and validate an artifact manifest (format + version gate);
+    shared by :class:`ServedModel` and the decoder-artifact loader in
+    ``serving/model.py``."""
+    with open(os.path.join(dirname, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "paddle-tpu-serving":
+        raise ValueError(f"{dirname}: not a paddle-tpu-serving artifact")
+    if manifest.get("version", 0) > max_version:
+        raise ValueError(
+            f"{dirname}: artifact version {manifest['version']} is newer "
+            f"than this loader (supports <= {max_version})")
+    return manifest
+
+
+def load_weight_entries(dirname: str,
+                        wsec: Dict[str, Any]) -> List[np.ndarray]:
+    """Materialize a manifest ``weights`` section: dequantize int8
+    entries ONCE (per-output-channel ``q.astype(f32) * scale``), pass
+    raw entries through, in manifest order."""
+    weights: List[np.ndarray] = []
+    npz = np.load(os.path.join(dirname, wsec["file"]))
+    for e in wsec["entries"]:
+        dt = _np_dtype(e["dtype"])
+        if e["quantized"]:
+            ax = e.get("axis")
+            w = _dequantize(npz["q::" + e["name"]],
+                            npz["s::" + e["name"]],
+                            -1 if ax is None else ax, dt)
+        else:
+            w = np.asarray(npz["w::" + e["name"]], dtype=dt)
+        weights.append(w)
+    return weights
 
 
 class ServedModel:
@@ -83,33 +119,29 @@ class ServedModel:
             # a process loading a serving artifact pushes (when
             # --fleet_addr is set) as role=serving; a dict write, free
             _fleet.set_identity(role="serving")
-        with open(os.path.join(dirname, "manifest.json")) as f:
-            manifest = json.load(f)
-        if manifest.get("format") != "paddle-tpu-serving":
-            raise ValueError(f"{dirname}: not a paddle-tpu-serving artifact")
-        if manifest.get("version", 0) > 2:
+        manifest = read_manifest(dirname)
+        if manifest.get("kind") == "decoder":
             raise ValueError(
-                f"{dirname}: artifact version {manifest['version']} is newer "
-                "than this loader (supports <= 2)")
+                f"{dirname}: decoder artifact — load it with "
+                "paddle_tpu.serving.DecoderModel.from_artifact, not "
+                "ServedModel (no StableHLO module to call)")
         with open(os.path.join(dirname, manifest["module"]), "rb") as f:
             exported = jax.export.deserialize(f.read())
         weights: List[np.ndarray] = []
         wsec = manifest.get("weights")
         if wsec:   # v2 quantized artifact: dequantize once, at load
-            npz = np.load(os.path.join(dirname, wsec["file"]))
-            for e in wsec["entries"]:
-                dt = _np_dtype(e["dtype"])
-                if e["quantized"]:
-                    ax = e.get("axis")
-                    w = _dequantize(npz["q::" + e["name"]],
-                                    npz["s::" + e["name"]],
-                                    -1 if ax is None else ax, dt)
-                else:
-                    w = np.asarray(npz["w::" + e["name"]], dtype=dt)
-                weights.append(w)
+            weights = load_weight_entries(dirname, wsec)
         return cls(manifest, exported, weights)
 
-    def __call__(self, **feeds) -> Dict[str, np.ndarray]:
+    def __call__(self, n_requests: int = 1, **feeds) -> Dict[str, np.ndarray]:
+        """Run one inference call carrying ``n_requests`` logical
+        requests (a continuous-batching decode step batches N of them
+        into one launch).  Telemetry is per REQUEST, not per launch:
+        ``serve_requests`` ticks by N and ``serve_infer_seconds`` gets N
+        observations, so fleet dashboards and reservoir quantiles stay
+        comparable between batched and sequential serving."""
+        if n_requests < 1:
+            raise ValueError(f"n_requests must be >= 1, got {n_requests}")
         args = []
         for spec in self.manifest["feeds"]:
             name = spec["name"]
@@ -137,8 +169,16 @@ class ServedModel:
         # np.asarray above synchronized the device, so this is true
         # end-to-end inference latency
         if _histogram is not None:
-            _histogram("serve_infer_seconds",
-                       "end-to-end ServedModel call latency").observe(
-                time.perf_counter() - t0)
-            _counter("serve_requests", "ServedModel calls served").inc()
+            # amortized per-request latency, observed once PER REQUEST:
+            # quantiles over requests, not over launches of varying width
+            per_req = (time.perf_counter() - t0) / n_requests
+            h = _histogram("serve_infer_seconds",
+                           "per-request ServedModel inference latency")
+            for _ in range(n_requests):
+                h.observe(per_req)
+            _counter("serve_requests",
+                     "requests served").inc(n_requests)
+            _gauge("serve_batch_size",
+                   "requests in the most recent inference launch").set(
+                n_requests)
         return result

@@ -21,6 +21,11 @@ wall-clock or RNG draws — so chaos tests stay reproducible:
   SIGTERMed (exercising the graceful-shutdown flush) and restarted
   under the same logical fleet id — the chaos driver for the fleet
   observatory's staleness/recovery rollup.
+- :class:`ServeServerProcess` — a continuous-batching inference server
+  child (real :class:`~paddle_tpu.serving.server.InferenceServer`,
+  real page-pool snapshots) serving an endless request stream, built
+  to be SIGKILLed mid-decode so a restart from the same snapshot path
+  must prove the allocator state was never torn.
 
 Everything is loopback/local-fs only; no real network is ever touched.
 """
@@ -298,6 +303,112 @@ class FleetPusherProcess:
         return self.proc.returncode
 
     def __enter__(self) -> "FleetPusherProcess":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.kill()
+
+
+# --------------------------------------------- serving server process
+# The child runs a REAL InferenceServer over a REAL page pool with
+# atomic snapshots, serving an endless request stream — so a SIGKILL
+# lands between (or inside) pool mutations with high probability.  The
+# decoder is deliberately tiny: the chaos under test is allocator
+# persistence, not the math.
+_SERVE_SCRIPT = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+snap, max_batch, n_pages, page_size = sys.argv[1:5]
+from paddle_tpu.serving.model import (DecoderConfig, DecoderModel,
+                                      init_decoder_params)
+from paddle_tpu.serving.server import InferenceServer
+
+cfg = DecoderConfig(vocab=64, dim=32, heads=2, layers=1, ffn=64,
+                    max_context=64, eos_id=1)
+model = DecoderModel(init_decoder_params(cfg, seed=0), cfg)
+srv = InferenceServer(model, max_batch=int(max_batch),
+                      n_pages=int(n_pages), page_size=int(page_size),
+                      continuous=True, snapshot_path=snap).start()
+print("READY", os.getpid(), flush=True)
+i = 0
+while True:      # endless churn: every finish releases pages and
+    r = srv.submit([2 + (i % 60)] * (2 + i % 10),   # rewrites the
+                   max_new_tokens=6)                # snapshot
+    srv.result(r, timeout=60.0)
+    print("SERVED", i, flush=True)
+    i += 1
+"""
+
+
+class ServeServerProcess:
+    """A continuous-batching inference server in a SIGKILL-able child.
+
+    ``start()`` spawns the child and blocks on its READY line (server
+    thread up, pool snapshotting to ``snapshot_path``);
+    :meth:`wait_served` blocks until N requests completed — guaranteeing
+    the snapshot has been rewritten through real alloc/release churn
+    before the fault lands; ``kill()`` SIGKILLs (the preemption model:
+    no flush hook, a snapshot write may be mid-flight — exactly the torn
+    state :class:`~paddle_tpu.serving.pagepool.TornSnapshot` exists
+    for).  The restarted server is built by the TEST in-process from the
+    same snapshot path with the same geometry (``max_batch``,
+    ``n_pages``, ``page_size`` attributes) and must verify clean."""
+
+    def __init__(self, snapshot_path: str, max_batch: int = 4,
+                 n_pages: int = 32, page_size: int = 8):
+        self.snapshot_path = snapshot_path
+        self.max_batch = max_batch
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.proc: Optional[subprocess.Popen] = None
+
+    def start(self, ready_timeout_s: float = 120.0) -> "ServeServerProcess":
+        assert self.proc is None or self.proc.poll() is not None, \
+            "serve process already running"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_SCRIPT, self.snapshot_path,
+             str(self.max_batch), str(self.n_pages),
+             str(self.page_size)],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = self.proc.stdout.readline()   # blocks until READY
+        assert line.startswith("READY"), \
+            f"serve child failed to start: {line!r}"
+        return self
+
+    def wait_served(self, n: int = 5, timeout_s: float = 120.0) -> int:
+        """Block until the child reports ``n`` completed requests (so
+        the snapshot demonstrably went through churn).  Returns the
+        last completed request index."""
+        assert self.proc is not None
+        deadline = time.monotonic() + timeout_s
+        last = -1
+        while last + 1 < n:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"serve child completed only {last + 1}/{n} "
+                    f"requests in {timeout_s}s")
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError("serve child died before serving")
+            if line.startswith("SERVED"):
+                last = int(line.split()[1])
+        return last
+
+    def kill(self) -> None:
+        """SIGKILL — preemption: no shutdown hook, no final snapshot
+        flush; whatever bytes were mid-write stay mid-written."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+    def __enter__(self) -> "ServeServerProcess":
         return self.start()
 
     def __exit__(self, *exc) -> None:

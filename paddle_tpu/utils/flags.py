@@ -344,6 +344,45 @@ FLAGS.define("roofline_peak_flops", 0.0,
 FLAGS.define("roofline_peak_gbps", 0.0,
              "override the detected HBM bandwidth (GB/s) for roofline "
              "verdicts (0 = auto-detect from the device kind)")
+FLAGS.define("serve_port", 0,
+             "serving HTTP endpoint (serving/server.py): POST "
+             "/v1/generate with {'prompt': [token ids], "
+             "'max_new_tokens': n} blocks until generation completes "
+             "and returns the tokens; GET /healthz reports queue depth "
+             "and page-pool occupancy.  0 picks a free port when the "
+             "server is started with serve_http=True; the loopback/"
+             "trusted-bind rules of --metrics_bind apply via "
+             "--serve_bind")
+FLAGS.define("serve_bind", "",
+             "bind host for the serving endpoint; empty = loopback "
+             "only (same trust contract as --metrics_bind: 0.0.0.0 "
+             "requires PADDLE_TPU_TRUST_NETWORK=1)")
+FLAGS.define("serve_max_batch", 8,
+             "continuous-batching decode width (serving/server.py): "
+             "at most this many requests share one "
+             "paged_decode_attention launch; new admissions join "
+             "between decode steps up to this cap")
+FLAGS.define("serve_continuous", True,
+             "continuous batching in the inference server: requests "
+             "join the in-flight decode batch between steps and "
+             "prefill is packed across admissions "
+             "(flash_attention_packed).  false = the kill switch — "
+             "sequential single-request serving (admit one, prefill "
+             "alone, decode to completion, then the next), "
+             "byte-for-byte the same generated tokens")
+FLAGS.define("kv_pool_pages", 128,
+             "physical pages in the shared serving KV pool "
+             "(serving/pagepool.py); each request holds "
+             "ceil(context/--kv_page_size) pages via its page table "
+             "and returns them on completion for recycling")
+FLAGS.define("kv_page_size", 16,
+             "tokens per KV page (the paged_decode_attention page "
+             "axis); pool capacity in tokens is kv_pool_pages x "
+             "kv_page_size")
+FLAGS.define("serve_slo_ms", 0.0,
+             "optional p99 TTFT SLO in milliseconds: when > 0 the "
+             "server's /healthz and the bench serving lane report "
+             "slo_met from the serve_ttft_seconds reservoir p99")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("prefetch_depth", 2,
              "async input pipeline depth (data/pipeline.py): max "

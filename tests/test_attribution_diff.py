@@ -26,11 +26,15 @@ ATT_OLD = os.path.join(REPO, "benchmark", "rooflines",
                        "attn_t2048_causal_before.json")
 ATT_NEW = os.path.join(REPO, "benchmark", "rooflines",
                        "attn_t2048_causal_after.json")
+DEC_DENSE = os.path.join(REPO, "benchmark", "rooflines",
+                         "attn_decode_dense.json")
+DEC_PAGED = os.path.join(REPO, "benchmark", "rooflines",
+                         "attn_decode_paged.json")
 
 
 # ------------------------------------------------------------- schema
 def test_committed_dumps_are_schema_v2():
-    for path in (OLD, NEW, ATT_OLD, ATT_NEW):
+    for path in (OLD, NEW, ATT_OLD, ATT_NEW, DEC_DENSE, DEC_PAGED):
         rep = costmodel.load_report(path)
         assert rep["schema"] == costmodel.SCHEMA_VERSION == 2
         assert rep["regions"] and rep["peaks"]["ridge"] > 0
@@ -207,6 +211,41 @@ def test_attention_block_sparse_dumps_pin_30pct_byte_cut(capsys):
                for i in diff["improvements"])
     # the win must show in the step totals, not just the regions
     assert diff["totals"]["bytes_per_step_delta_frac"] < -0.05
+
+
+def test_decode_dumps_pin_paged_window_proportionality(capsys):
+    """Round-20 acceptance, closing the round-19 caveat ("the serving
+    kernels have no attributed-traffic row yet"): the committed decode
+    dumps (benchmark/rooflines/attn_decode_*.json, regenerated by
+    make_attention_dumps.py) attribute ONE serving decode step — dense
+    contiguous-cache gather vs the paged kernel — and replay through
+    ``bench.py --attribution_diff --check`` clean.  The structural
+    property pinned is window proportionality: a dense cache reserves
+    (and reads) the full max-context window per row, the paged table
+    maps only the pages the row's tokens occupy — so at 256 of 2048
+    tokens the paged step's attn-region bytes fall ≥15 % and its
+    attributed FLOPs ≥80 %.  (Per-page DMA constants are interpret-mode
+    inflated on CPU, which is why the pin is the window ratio, not an
+    absolute byte count.)"""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    rc = bench.main(["--attribution_diff", DEC_DENSE, DEC_PAGED,
+                     "--check"])
+    assert rc == 0
+    diff = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert diff["kind"] == "attribution_diff" and diff["ok"] is True
+    rows = {r["region"]: r for r in diff["regions"]}
+    attn = [r for name, r in rows.items() if name.startswith("attn")]
+    assert attn, sorted(rows)
+    for r in attn:
+        assert r["status"] == "common"
+        assert r["bytes_delta_frac"] <= -0.15, r
+        assert r["flops_delta_frac"] <= -0.80, r
+    assert any(i["region"].startswith("attn") and i["field"] == "bytes"
+               for i in diff["improvements"])
 
 
 def test_bench_attribution_diff_check_exits_2_on_regression(tmp_path):
