@@ -961,6 +961,180 @@ def bench_serving():
     return r
 
 
+# --multichip_small: CPU-runnable shapes for the FSDP scaling lane
+MULTICHIP_SMALL = False
+
+
+def _multichip_shapes():
+    """(T, D, heads, layers, ffn, V, per-chip batch, scan iters) for
+    the multichip lane's transformer-zoo row.  Small-scale dims are all
+    divisible by 8 so every rule-table entry actually shards on the
+    8-virtual-device CPU mesh tier-1 replays."""
+    if MULTICHIP_SMALL:
+        return 16, 64, 2, 1, 128, 1024, 4, 8
+    return 128, 512, 8, 4, 2048, 30000, 16, 32
+
+
+def _multichip_trainer(n_devices, fsdp, batch, seed=0):
+    """One transformer-zoo trainer on a ``data=n`` mesh (FSDP on/off)
+    plus its fixed-seed feed.  Installs the mesh as the process global
+    (the trainer's feed sharding reads it)."""
+    from paddle_tpu.config.model_config import OptimizationConfig
+    from paddle_tpu.core.device import build_mesh, set_mesh
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.layers.network import NeuralNetwork
+    from paddle_tpu.models import transformer_text_classifier
+    from paddle_tpu.parallel import transformer_fsdp_rules
+    from paddle_tpu.trainer.trainer import Trainer
+
+    T, D, HEADS, L, F, V, _, _ = _multichip_shapes()
+    devices = jax.devices()[:n_devices]
+    mesh = build_mesh({"data": len(devices)}, devices)
+    set_mesh(mesh)
+    cfg = transformer_text_classifier(
+        vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
+        ffn_dim=F, num_classes=2, max_len=T)
+    trainer = Trainer(
+        NeuralNetwork(cfg),
+        opt_config=OptimizationConfig(
+            learning_method="adam", learning_rate=1e-3,
+            gradient_clipping_threshold=25.0),
+        mesh=mesh, seed=0, fsdp=fsdp,
+        fsdp_rules=transformer_fsdp_rules())
+    rng = np.random.RandomState(seed)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(
+                    rng.randint(0, V, (batch, T)).astype(np.int32)),
+                jax.numpy.asarray(np.full((batch,), T, np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (batch,)).astype(np.int32))}
+    return trainer, feed
+
+
+def _multichip_mode_run(n, fsdp, batch, iters, keep=False):
+    """Time one (chip count, FSDP mode, global batch) cell and read the
+    per-chip HBM category gauges off it.  ``params_bytes_per_chip`` /
+    ``opt_state_bytes_per_chip`` are the lane's whole point: under FSDP
+    they must shrink with the chip count while replicated mode pays the
+    full model everywhere.  (Informational fields — the gate's series
+    key is ``samples_per_sec``.)  ``keep=True`` also returns the live
+    trainer/feed so the lane can attach the observatory stamp to one
+    representative cell."""
+    trainer, feed = _multichip_trainer(n, fsdp, batch)
+    ms, agree = _scan_time_ms(trainer, feed, iters=iters)
+    cats = omem.account(trainer, feed)["categories"]
+    res = {
+        "samples_per_sec": round(batch / (ms / 1e3), 3),
+        "step_ms": round(ms, 3),
+        "params_bytes_per_chip": int(cats.get("params", 0)),
+        "opt_state_bytes_per_chip": int(cats.get("opt_state", 0)),
+        "timing_self_check": round(agree, 4),
+    }
+    return (res, trainer, feed) if keep else res
+
+
+def bench_multichip():
+    """Multi-chip FSDP scaling lane (`--only multichip`, round 21).
+
+    Weak scaling (fixed per-chip batch) and strong scaling (fixed
+    global batch) of the transformer-zoo train step over ``data`` =
+    1/2/4/8 chips with ``--fsdp`` on — params AND Adam slots sharded
+    over the mesh (``parallel/rule_tables.py`` transformer table) —
+    plus a replicated A/B at the widest mesh, so the artifact carries
+    samples/sec AND the per-chip ``hbm_category_bytes`` win on one
+    line.  On CPU the 8 "chips" are virtual devices sharing the same
+    cores, so throughput scaling is about program correctness (the
+    collectives run) rather than speedup; the HBM columns are exact
+    either way.
+
+    The lane also replays the kill-switch contract every run:
+    ``--fsdp`` on a 1-chip mesh must be byte-for-byte the replicated
+    program (3 fixed-seed steps, params compared exactly) — the same
+    pin tests/test_fsdp.py holds.
+    """
+    from paddle_tpu.core import device as _dev
+
+    T, D, HEADS, L, F, V, per_chip, iters = _multichip_shapes()
+    saved_mesh = _dev._mesh
+    n_avail = len(jax.devices())
+    chip_counts = [n for n in (1, 2, 4, 8) if n <= n_avail]
+    max_n = chip_counts[-1]
+    global_batch = per_chip * max_n
+    try:
+        rows, weak, strong = [], {}, {}
+        stamp_tr = stamp_feed = None
+        for n in chip_counts:
+            out = _multichip_mode_run(n, True, per_chip * n, iters,
+                                      keep=(n == 1))
+            if n == 1:
+                # the 1-chip cell carries the observatory stamp: its
+                # step is the plain single-device program the cost
+                # model attributes exactly
+                weak[n], stamp_tr, stamp_feed = out
+            else:
+                weak[n] = out
+            rows.append({"workload": f"weak_d{n}", "fsdp": weak[n]})
+        # the FSDP win's denominator: full replication at the widest mesh
+        repl = _multichip_mode_run(max_n, False, global_batch, iters)
+        rows[-1]["replicated"] = repl
+        for n in chip_counts:
+            # weak@max_n IS the fixed-global-batch point — reuse it
+            strong[n] = weak[n] if n == max_n else \
+                _multichip_mode_run(n, True, global_batch, iters)
+            if n != max_n:
+                rows.append({"workload": f"strong_d{n}",
+                             "fsdp": strong[n]})
+
+        # kill-switch contract: --fsdp on a 1-chip mesh is the SAME
+        # program as --fsdp=false — byte-identical params after 3
+        # fixed-seed steps
+        t_on, feed1 = _multichip_trainer(1, True, per_chip, seed=1)
+        t_off, _ = _multichip_trainer(1, False, per_chip, seed=1)
+        for _ in range(3):
+            t_on.train_one_batch(feed1)
+            t_off.train_one_batch(feed1)
+        for a, b in zip(jax.tree_util.tree_leaves(t_on.params),
+                        jax.tree_util.tree_leaves(t_off.params)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise RuntimeError(
+                    "fsdp kill-switch contract violated: --fsdp on a "
+                    "1-chip mesh diverged from --fsdp=false")
+
+        fsdp_bytes = (weak[max_n]["params_bytes_per_chip"]
+                      + weak[max_n]["opt_state_bytes_per_chip"])
+        repl_bytes = (repl["params_bytes_per_chip"]
+                      + repl["opt_state_bytes_per_chip"])
+        sps = weak[max_n]["samples_per_sec"]
+        line = _with_band({
+            "metric": "multichip_samples_per_sec",
+            "value": sps,
+            "unit": f"samples/s (weak scaling, {max_n} chips × batch "
+                    f"{per_chip}, transformer {L}L/{HEADS}H d={D} "
+                    f"T={T}, fsdp)",
+            "devices": max_n,
+            "scale": "small" if MULTICHIP_SMALL else "bench",
+            "rows": rows,
+            "weak_scaling_eff": round(
+                sps / max(weak[1]["samples_per_sec"] * max_n, 1e-9), 3),
+            "strong_scaling_eff": round(
+                strong[max_n]["samples_per_sec"]
+                / max(strong[1]["samples_per_sec"] * max_n, 1e-9), 3),
+            "fsdp_hbm_win": round(repl_bytes / fsdp_bytes, 2)
+            if fsdp_bytes else 0.0,
+            "kill_switch_equal": True,
+            "vs_baseline_note": "reference's multi-device story is "
+                                "MultiGradientMachine thread-per-GPU "
+                                "replication — no sharded optimizer "
+                                "state; FSDP per-chip bytes are the "
+                                "new capability under measure",
+            "perf_stamp_of": "weak_d1.fsdp",
+        }, values=[sps])
+        return _finish(line, "multichip_weak_d1", stamp_tr, stamp_feed,
+                       step_ms=weak[1]["step_ms"])
+    finally:
+        _dev._mesh = saved_mesh
+
+
 # --pipeline_small: CPU-runnable shapes for the prefetch A/B lane
 PIPELINE_SMALL = False
 
@@ -1657,7 +1831,8 @@ def main(argv=None):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
 
     lanes = ["lstm", "resnet", "seq2seq", "attention", "lstm1280",
-             "lstm2048", "pipeline", "precision", "observe", "serving"]
+             "lstm2048", "pipeline", "precision", "observe", "serving",
+             "multichip"]
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     help="run a subset of lanes (comma-separated): "
@@ -1682,6 +1857,11 @@ def main(argv=None):
                          "lane with a CPU-sized decoder (the JSON line "
                          "records scale='small'); default is bench "
                          "scale")
+    ap.add_argument("--multichip_small", action="store_true",
+                    help="run the FSDP weak/strong scaling lane at CPU-"
+                         "runnable transformer shapes over the virtual-"
+                         "device mesh (the JSON line records "
+                         "scale='small'); default is bench scale")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace of a few production "
                          "train steps per workload (see --profile_dir); "
@@ -1755,6 +1935,9 @@ def main(argv=None):
     if args.serving_small:
         global SERVING_SMALL
         SERVING_SMALL = True
+    if args.multichip_small:
+        global MULTICHIP_SMALL
+        MULTICHIP_SMALL = True
     if args.attribution_diff:
         # pure-host replay of two committed dumps: no workload runs, no
         # backend touched — the kernel-PR verification loop stays fast
@@ -1784,7 +1967,8 @@ def main(argv=None):
                    "pipeline": bench_pipeline,
                    "precision": bench_precision,
                    "observe": bench_observe,
-                   "serving": bench_serving}
+                   "serving": bench_serving,
+                   "multichip": bench_multichip}
         order = [t.strip() for t in args.only.split(",") if t.strip()] \
             if args.only else lanes
         unknown = [t for t in order if t not in benches]
@@ -1810,7 +1994,8 @@ def main(argv=None):
             meta={"scale": ("small" if PIPELINE_SMALL
                             or PRECISION_SMALL
                             or ATTENTION_SMALL
-                            or SERVING_SMALL else "bench"),
+                            or SERVING_SMALL
+                            or MULTICHIP_SMALL else "bench"),
                   "argv": sys.argv[1:] if argv is None else list(argv)})
         print(f"wrote baseline {args.write_baseline} "
               f"({len(doc['series'])} series)", file=sys.stderr,

@@ -86,12 +86,13 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     # pipeline lane is a bounded ratio that would never see it).
     # Modes: pipeline sync/prefetch, precision fp32/bf16, attention
     # dense/legacy/block-skip + padded/packed + paged decode, serving
-    # continuous/sequential.
+    # continuous/sequential, multichip fsdp/replicated.
     for row in line.get("rows", ()):
         tag = row.get("workload", "?")
         for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
                      "legacy", "block_skip", "padded", "packed",
-                     "decode", "continuous", "sequential"):
+                     "decode", "continuous", "sequential",
+                     "fsdp", "replicated"):
             sub = row.get(mode) or {}
             for key, unit, direction, suffix in (
                     ("ms_per_batch", "ms/batch", "lower", "_ms"),
@@ -99,7 +100,12 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     # serving lane: sustained throughput gates
                     # higher-better, the p99 tail lower-better
                     ("req_per_sec", "req/s", "higher", "_req_per_sec"),
-                    ("p99_ms", "ms", "lower", "_p99_ms")):
+                    ("p99_ms", "ms", "lower", "_p99_ms"),
+                    # multichip lane: scaling throughput gates
+                    # higher-better; per-chip hbm fields are
+                    # informational (not series keys)
+                    ("samples_per_sec", "samples/s", "higher",
+                     "_samples_per_sec")):
                 v = sub.get(key)
                 if v is not None:
                     out[f"{metric}.{tag}.{mode}{suffix}"] = {

@@ -13,7 +13,11 @@ signal.  This module samples
   bytes to the trainer's known pytrees by **buffer identity**: params,
   opt_state, buffers (batch-norm stats), loss_scale, data (the feed),
   and ``other`` for everything unclaimed (mostly activations held by
-  in-flight dispatch and donated-buffer slack).
+  in-flight dispatch and donated-buffer slack).  Category figures are
+  **per-chip** (:func:`per_chip_bytes`): a leaf sharded n ways over
+  the mesh counts one shard, so the FSDP params/opt_state win is read
+  directly off the gauge; replicated/single-chip leaves read as their
+  full ``nbytes``, unchanged.
 
 Sampling discipline (the 26 µs/step no-sink contract): nothing here
 runs per step.  The trainer samples at pass boundaries — and only when
@@ -57,6 +61,29 @@ def tree_bytes(tree) -> int:
                for leaf in jax.tree_util.tree_leaves(tree))
 
 
+def per_chip_bytes(leaf) -> int:
+    """Bytes of ``leaf`` resident on ONE chip.
+
+    A sharded ``jax.Array``'s ``nbytes`` is the GLOBAL logical size —
+    useless for judging per-chip HBM headroom, which is what caps
+    model size.  This reads the sharding's per-device shard shape
+    instead: a replicated leaf costs its full size per chip, an
+    FSDP-sharded one costs ``nbytes / n_shards``.  Host numpy leaves
+    and scalars fall back to ``nbytes``."""
+    nb = int(getattr(leaf, "nbytes", 0) or 0)
+    sh = getattr(leaf, "sharding", None)
+    if not nb or sh is None:
+        return nb
+    try:
+        shard_shape = sh.shard_shape(leaf.shape)
+        n = 1
+        for d in shard_shape:
+            n *= int(d)
+        return n * int(leaf.dtype.itemsize)
+    except Exception:  # noqa: BLE001 — telemetry never kills the host
+        return nb
+
+
 def _category_trees(trainer, feed=None) -> Dict[str, Any]:
     cats: Dict[str, Any] = {}
     if trainer is not None:
@@ -80,6 +107,12 @@ def account(trainer=None, feed=None,
     buffer identity against the live-array set, so a leaf that is BOTH
     in ``trainer.params`` and alive is counted once under ``params``
     and never under ``other``.
+
+    Category bytes are **per-chip** (:func:`per_chip_bytes`): under
+    FSDP a parameter sharded 8 ways contributes 1/8 of its global
+    size, which is exactly the HBM-headroom question the gauges
+    answer; on a single chip or for replicated leaves the figure
+    equals ``nbytes``, so the legacy reading is unchanged.
     """
     global _live_peak
     import jax
@@ -91,7 +124,7 @@ def account(trainer=None, feed=None,
         n = 0
         if tree is not None:
             for leaf in jax.tree_util.tree_leaves(tree):
-                nb = int(getattr(leaf, "nbytes", 0) or 0)
+                nb = per_chip_bytes(leaf)
                 if nb and id(leaf) not in cat_ids:
                     cat_ids[id(leaf)] = name
                     n += nb
@@ -144,9 +177,11 @@ def sample(trainer=None, feed=None, device=None) -> Dict[str, Any]:
           "peak device memory (allocator peak_bytes_in_use; running "
           "max of samples on stat-less backends)").set(snap["peak_bytes"])
     cat = gauge("hbm_category_bytes",
-                "in-use bytes attributed to the trainer's known "
-                "pytrees by buffer identity; 'other' = unclaimed "
-                "(activations in flight, allocator slack)")
+                "PER-CHIP bytes attributed to the trainer's known "
+                "pytrees by buffer identity (sharded leaves count "
+                "their one-device shard — the FSDP win reads "
+                "directly); 'other' = unclaimed (activations in "
+                "flight, allocator slack)")
     for name, nbytes in snap["categories"].items():
         cat.set(nbytes, category=name)
     return snap

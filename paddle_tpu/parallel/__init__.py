@@ -17,7 +17,13 @@ annotations (SURVEY §2.5 mapping):
 
 from .sharding import (ShardingRules, tp_rules, shard_params,
                        constraint, param_dims_of,
-                       verify_rules_or_raise)  # noqa: F401
+                       verify_rules_or_raise,
+                       match_partition_rules, fsdp_spec,
+                       fsdp_rules_for, make_shard_and_gather_fns,
+                       spec_shard_info, FSDP_MIN_SIZE)  # noqa: F401
+from .rule_tables import (lstm_fsdp_rules, resnet_fsdp_rules,
+                          transformer_fsdp_rules, ctr_fsdp_rules,
+                          zoo_fsdp_rules, ZOO_FSDP_RULES)  # noqa: F401
 from .ring_attention import (ring_attention, ulysses_attention,
                              full_attention)  # noqa: F401
 from ..ops.pallas_attention import flash_attention  # noqa: F401

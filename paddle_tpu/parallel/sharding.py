@@ -13,10 +13,11 @@ import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.device import DATA_AXIS, MODEL_AXIS, get_mesh
-from ..utils import get_logger
+from ..utils import get_logger, warn_once
 
 log = get_logger("sharding")
 
@@ -34,8 +35,20 @@ class ShardingRules:
 
     def spec_for(self, name: str, ndim: int) -> P:
         for pat, spec in self.rules:
-            if pat.search(name) and len(spec) <= ndim:
-                return spec
+            if pat.search(name):
+                if len(spec) <= ndim:
+                    return spec
+                # a matching rule whose spec rank exceeds the param's
+                # falls through to the next rule (or replication) — say
+                # so once, or a typo'd table quietly replicates a
+                # 10^8-row embedding and the "win" is silence
+                warn_once(
+                    f"sharding.rank_excluded:{pat.pattern}:{name}",
+                    "sharding rule %r matches parameter %r but its "
+                    "spec %s has rank %d > param rank %d — rule "
+                    "skipped (next rule or replication applies)",
+                    pat.pattern, name, tuple(spec), len(spec), ndim,
+                    logger=log)
         return P()  # replicated
 
     def sharding_for(self, name: str, ndim: int,
@@ -124,3 +137,97 @@ def constraint(x, *spec, mesh: Optional[Mesh] = None):
     mesh = mesh or get_mesh()
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*spec)))
+
+
+# ===================================================== FSDP (data axis)
+#: Parameters smaller than this many ELEMENTS stay replicated under the
+#: auto heuristic: sharding a 64-float LayerNorm gain buys nothing and
+#: fragments the all-gather schedule.
+FSDP_MIN_SIZE = 1024
+
+
+def match_partition_rules(rules: ShardingRules,
+                          param_dims: Dict[str, Sequence[int]]) -> Dict[str, P]:
+    """Resolve a rule table against a parameter tree: name → the
+    PartitionSpec first-match-wins assigns (the SNIPPETS
+    ``match_partition_rules`` shape, over our name→dims census instead
+    of a pytree of arrays).  Scalars always resolve replicated."""
+    return {name: rules.spec_for(name, len(dims))
+            for name, dims in param_dims.items()}
+
+
+def fsdp_spec(shape: Sequence[int], n_shards: int,
+              axis: str = DATA_AXIS,
+              min_size: int = FSDP_MIN_SIZE) -> P:
+    """FSDP auto heuristic for one parameter: shard the LARGEST dim
+    divisible by ``n_shards`` over ``axis``; replicate when nothing
+    divides or the param is below ``min_size`` elements.  Used when no
+    committed rule table covers the model (``fsdp_rules_for`` derives a
+    whole tree's specs from it)."""
+    shape = tuple(int(d) for d in shape)
+    if n_shards <= 1 or not shape \
+            or int(np.prod(shape)) < max(min_size, 1):
+        return P()
+    best = -1
+    for d, size in enumerate(shape):
+        if size % n_shards == 0 and size > 0 \
+                and (best < 0 or size > shape[best]):
+            best = d
+    if best < 0:
+        return P()
+    entries: List[Optional[str]] = [None] * len(shape)
+    entries[best] = axis
+    return P(*entries)
+
+
+def fsdp_rules_for(param_dims: Dict[str, Sequence[int]],
+                   n_shards: int, axis: str = DATA_AXIS,
+                   min_size: int = FSDP_MIN_SIZE) -> Dict[str, P]:
+    """Auto-derived FSDP placement for a whole parameter tree:
+    name → spec via :func:`fsdp_spec` (largest divisible dim over the
+    ``data`` axis).  The committed per-zoo tables in
+    :mod:`paddle_tpu.parallel.rule_tables` take precedence when the
+    model is a known zoo member — they encode intent (replicate norms
+    and biases, shard matmul weights on a stable dim) where the
+    heuristic only encodes divisibility."""
+    return {name: fsdp_spec(dims, n_shards, axis, min_size)
+            for name, dims in param_dims.items()}
+
+
+def make_shard_and_gather_fns(specs: Dict[str, P],
+                              mesh: Optional[Mesh] = None):
+    """Per-name (shard_fn, gather_fn) pairs for a resolved spec dict —
+    the SNIPPETS [3] shape.  ``shard_fns[name](x)`` commits ``x`` to
+    its NamedSharding; ``gather_fns[name](x)`` brings the global array
+    back fully replicated (checkpoint writers and debuggers use it)."""
+    mesh = mesh or get_mesh()
+    rep = NamedSharding(mesh, P())
+
+    def _shard(sh):
+        return lambda x: jax.device_put(x, sh)
+
+    def _gather(x):
+        return jax.device_put(x, rep)
+
+    shard_fns = {name: _shard(NamedSharding(mesh, spec))
+                 for name, spec in specs.items()}
+    gather_fns = {name: _gather for name in specs}
+    return shard_fns, gather_fns
+
+
+def spec_shard_info(spec: P, mesh: Mesh) -> Optional[Tuple[int, int]]:
+    """``(dim, n_shards)`` of the FIRST sharded dim of ``spec`` on
+    ``mesh`` (None when fully replicated) — the shape sharded
+    checkpoints record per parameter so a loader can reassemble the
+    global array without a mesh."""
+    axes_by_name = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for d, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for ax in names:
+            n *= int(axes_by_name.get(ax, 1))
+        if n > 1:
+            return d, n
+    return None

@@ -89,9 +89,19 @@ class Trainer:
                  optimizer: Optional[Optimizer] = None,
                  opt_config: Optional[OptimizationConfig] = None,
                  mesh=None, seed: Optional[int] = None,
-                 sharding_rules=None):
+                 sharding_rules=None, fsdp: Optional[bool] = None,
+                 fsdp_rules=None):
         self.network = network
         self.sharding_rules = sharding_rules
+        # FSDP over the data axis (--fsdp): parameters AND optimizer
+        # slots sharded per _resolve_fsdp(); fsdp_rules is a committed
+        # per-zoo ShardingRules table (parallel/rule_tables.py), else
+        # the largest-divisible-dim heuristic places each param.  On a
+        # 1-chip data axis the mode is inert — the replicated path,
+        # byte-for-byte (the kill-switch contract bench_multichip pins).
+        self.fsdp = bool(FLAGS.fsdp) if fsdp is None else bool(fsdp)
+        self.fsdp_rules = fsdp_rules
+        self._fsdp_shardings = None
         if optimizer is None:
             optimizer, self.schedule = optimizer_from_config(
                 opt_config or OptimizationConfig())
@@ -206,9 +216,62 @@ class Trainer:
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, replicated(self.mesh)), tree)
 
+    def _resolve_fsdp(self):
+        """Resolve the FSDP placement once: param name → ``(shape,
+        NamedSharding)`` over the ``data`` axis, from ``fsdp_rules``
+        (the committed per-zoo table) else the largest-divisible-dim
+        heuristic (:func:`paddle_tpu.parallel.sharding.fsdp_spec`).
+        None when FSDP is off or the data axis has a single shard —
+        every placement/step call site then takes its legacy branch
+        byte-for-byte (the ``--fsdp=false`` kill-switch contract)."""
+        n = self.mesh.shape.get(DATA_AXIS, 1)
+        if not self.fsdp or n <= 1:
+            return None
+        if self._fsdp_shardings is None:
+            from jax.sharding import NamedSharding
+            from ..parallel.sharding import fsdp_spec, spec_shard_info
+            from ..utils import warn_once
+            min_size = int(FLAGS.fsdp_min_size)
+            specs = {}
+            for name, value in self.params.items():
+                leaves = jax.tree_util.tree_leaves(value)
+                shape = tuple(np.shape(leaves[0])) if leaves else ()
+                if self.fsdp_rules is not None:
+                    spec = self.fsdp_rules.spec_for(name, len(shape))
+                    info = spec_shard_info(spec, self.mesh)
+                    if info is not None and shape[info[0]] % info[1]:
+                        # an indivisible table entry would be a
+                        # pod-compile failure — degrade to replicated
+                        # and say so (the preflight/tests catch this
+                        # for committed tables; user tables may meet
+                        # sizes the author never saw)
+                        warn_once(
+                            f"trainer.fsdp_indivisible:{name}",
+                            "FSDP rule spec %s for %r does not divide "
+                            "shape %s on a %d-way data axis — "
+                            "replicating this parameter",
+                            tuple(spec), name, shape, n, logger=log)
+                        spec = jax.sharding.PartitionSpec()
+                else:
+                    spec = fsdp_spec(shape, n, min_size=min_size)
+                specs[name] = (shape, NamedSharding(self.mesh, spec))
+            self._fsdp_shardings = specs
+        return self._fsdp_shardings
+
     def _place_params(self, params):
-        """Tensor-parallel placement: honor sharding_rules (per-parameter
-        PartitionSpec, ``parallel_nn`` equivalent) else replicate."""
+        """FSDP placement (``--fsdp``: every parameter sharded over
+        ``data``), else tensor-parallel placement honoring
+        sharding_rules (per-parameter PartitionSpec, ``parallel_nn``
+        equivalent), else replicate."""
+        fs = self._resolve_fsdp()
+        if fs is not None:
+            rep = replicated(self.mesh)
+            return {
+                name: jax.tree_util.tree_map(
+                    lambda x, e=fs[name]: jax.device_put(
+                        x, e[1] if tuple(np.shape(x)) == e[0] else rep),
+                    value)
+                for name, value in params.items()}
         if self.sharding_rules is None or self.mesh.devices.size <= 1:
             return self._replicate(params)
         from ..parallel.sharding import shard_params
@@ -216,8 +279,12 @@ class Trainer:
 
     def _place_opt_state(self, opt_state, params):
         """Optimizer slots (Adam moments etc.) shard like their parameter —
-        otherwise TP's memory win is lost and XLA reshards every step."""
-        if self.sharding_rules is None or self.mesh.devices.size <= 1:
+        otherwise the sharding's memory win is lost and XLA reshards
+        every step.  Covers both modes: FSDP (``data``-axis specs from
+        ``_resolve_fsdp``) and TP (``sharding_rules``)."""
+        fs = self._resolve_fsdp()
+        if fs is None and (self.sharding_rules is None
+                           or self.mesh.devices.size <= 1):
             return self._replicate(opt_state)
         count, slots = opt_state
         p_leaves, treedef = jax.tree_util.tree_flatten(params)
@@ -227,8 +294,14 @@ class Trainer:
                      params)[0]]
         placed_slots = []
         for name, p, slot in zip(names, p_leaves, slots):
-            sh = self.sharding_rules.sharding_for(
-                name, getattr(p, "ndim", 0), self.mesh)
+            if fs is not None:
+                ent = fs.get(name)
+                sh = ent[1] if ent is not None \
+                    and tuple(np.shape(p)) == ent[0] \
+                    else replicated(self.mesh)
+            else:
+                sh = self.sharding_rules.sharding_for(
+                    name, getattr(p, "ndim", 0), self.mesh)
 
             def place(x, sh=sh, pshape=np.shape(p)):
                 if np.shape(x) == pshape:
@@ -236,6 +309,49 @@ class Trainer:
                 return jax.device_put(x, replicated(self.mesh))
             placed_slots.append(jax.tree_util.tree_map(place, slot))
         return (jax.device_put(count, replicated(self.mesh)), placed_slots)
+
+    def _fsdp_constrainers(self):
+        """``(constrain_params, constrain_opt)`` for the train-step
+        builders: identity pass-throughs when FSDP is inactive (the
+        legacy jaxpr, byte-for-byte), else
+        ``jax.lax.with_sharding_constraint`` appliers that pin
+        gradients, updated parameters, and param-shaped optimizer
+        slots to their ``data``-axis sharding — the annotations that
+        make XLA's partitioner emit the all-gather/reduce-scatter pair
+        instead of a dense all-reduce plus per-step reshards."""
+        fs = self._resolve_fsdp()
+        if fs is None:
+            return (lambda tree: tree), (lambda opt: opt)
+
+        def constrain_leaf(x, ent):
+            if ent is not None and tuple(np.shape(x)) == ent[0]:
+                return jax.lax.with_sharding_constraint(x, ent[1])
+            return x
+
+        def constrain_params(tree):
+            return {
+                name: jax.tree_util.tree_map(
+                    lambda x, e=fs.get(name): constrain_leaf(x, e),
+                    value)
+                for name, value in tree.items()}
+
+        # opt slots align with the flattened param leaves — the same
+        # order _place_opt_state places them in
+        names = [".".join(str(k.key) if hasattr(k, "key") else str(k)
+                          for k in path)
+                 for path, _ in jax.tree_util.tree_flatten_with_path(
+                     self.params)[0]]
+
+        def constrain_opt(opt):
+            count, slots = opt
+            out = []
+            for name, slot in zip(names, slots):
+                ent = fs.get(name)
+                out.append(jax.tree_util.tree_map(
+                    lambda x, e=ent: constrain_leaf(x, e), slot))
+            return (count, out)
+
+        return constrain_params, constrain_opt
 
     def _step_extras(self) -> Tuple:
         """Trailing jitted-step inputs beyond ``(params, opt_state,
@@ -277,6 +393,9 @@ class Trainer:
         hs = self._health
         hs_stats = hs.stats_fn() if hs is not None else None
         from ..observe import health as _health
+        # FSDP (--fsdp): sharding constraints threaded through the step
+        # (identity closures when inactive — the legacy jaxpr)
+        c_params, c_opt = self._fsdp_constrainers()
 
         def step(params, opt_state, buffers, feed, rng, progress,
                  *health_state):
@@ -287,6 +406,7 @@ class Trainer:
 
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            grads = c_params(grads)
             if self._prune_masks:
                 from ..optimizer.hooks import apply_prune_grads
                 grads = apply_prune_grads(grads, self._prune_masks)
@@ -304,6 +424,8 @@ class Trainer:
                 new_params, new_opt = opt.apply(params, grads, opt_state,
                                                 lr, lr_scales,
                                                 sparse_masks=masks)
+                new_params = c_params(new_params)
+                new_opt = c_opt(new_opt)
             if hs_stats is not None:
                 # the health aux scopes as its own attribution region,
                 # like the optimizer — it must not pollute layer costs
@@ -351,6 +473,9 @@ class Trainer:
         hs = self._health
         hs_stats = hs.stats_fn() if hs is not None else None
         from ..observe import health as _health
+        # FSDP (--fsdp): sharding constraints threaded through the step
+        # (identity closures when inactive — the legacy jaxpr)
+        c_params, c_opt = self._fsdp_constrainers()
 
         def step(params, opt_state, buffers, feed, rng, progress,
                  ls_state, *health_state):
@@ -369,6 +494,7 @@ class Trainer:
                 (_, (loss, new_buffers)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
             grads = ls.unscale(grads, ls_state.scale)
+            grads = c_params(grads)
             if hs_stats is not None:
                 # skip-step disambiguation: ONE isfinite sweep yields
                 # both the loss-scale skip decision and the per-layer
@@ -396,6 +522,8 @@ class Trainer:
                 new_opt = ls.select(finite, new_opt, opt_state)
                 new_buffers = ls.select(finite, new_buffers, buffers)
                 new_ls = ls.update(ls_state, finite, growth_interval)
+                new_params = c_params(new_params)
+                new_opt = c_opt(new_opt)
             if hs_stats is not None:
                 # post-select new_params: a skipped step reports a zero
                 # update norm (nothing was applied), and its non-finite
@@ -929,7 +1057,8 @@ class Trainer:
                 "skipped_total": int(self._ls_state.skipped_total),
             }
         return save_checkpoint(save_dir, pass_id, self.params,
-                               self.opt_state, self.buffers, meta=meta)
+                               self.opt_state, self.buffers, meta=meta,
+                               shard=self._resolve_fsdp() is not None)
 
     def load(self, ckpt_dir: str, _verified: bool = False) -> None:
         # _verified: resume() already digest-checked this dir via
@@ -956,6 +1085,14 @@ class Trainer:
         opt = load_opt_state(ckpt_dir, self.opt_state)
         if opt is not None:
             self.opt_state = opt
+        if self._resolve_fsdp() is not None:
+            # resharding-on-load: checkpoints come back as FULL arrays
+            # (shard files reassembled by the loader) whatever mesh
+            # wrote them; re-place for THIS trainer's mesh so an FSDP
+            # resume holds shards, not silent replicas
+            self.params = self._place_params(self.params)
+            self.opt_state = self._place_opt_state(self.opt_state,
+                                                   self.params)
         try:
             man = load_manifest(ckpt_dir)
             self.samples_seen = man.get("samples_seen", 0)

@@ -384,6 +384,21 @@ FLAGS.define("serve_slo_ms", 0.0,
              "server's /healthz and the bench serving lane report "
              "slo_met from the serve_ttft_seconds reservoir p99")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
+FLAGS.define("fsdp", False,
+             "shard parameters AND optimizer slots over the 'data' "
+             "mesh axis (FSDP): per-chip params/opt_state HBM drops "
+             "by the data-axis extent while XLA turns the gradient "
+             "all-reduce into an all-gather/reduce-scatter pair; "
+             "placement comes from the trainer's fsdp_rules table "
+             "(parallel/rule_tables.py for zoo models) else the "
+             "largest-divisible-dim heuristic.  --fsdp=false is the "
+             "kill switch: the replicated path, byte-for-byte")
+FLAGS.define("fsdp_min_size", 1024,
+             "parameters below this many elements stay replicated "
+             "under the FSDP auto heuristic (norm gains, biases): "
+             "sharding KiB-scale tensors fragments collectives for "
+             "no memory win; rule-table entries are exempt — a "
+             "committed table says exactly what it means")
 FLAGS.define("prefetch_depth", 2,
              "async input pipeline depth (data/pipeline.py): max "
              "batches in flight between the reader and the train step "

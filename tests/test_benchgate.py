@@ -83,6 +83,37 @@ def test_series_from_composite_lane_rows():
                if k != "pipe")
 
 
+def test_series_from_multichip_lane_rows():
+    """The FSDP scaling lane gates throughput per (chip count, mode)
+    row; the per-chip HBM byte columns ride along informationally and
+    must NOT become gated series (they change on purpose whenever the
+    sharding layout improves)."""
+    line = {
+        "metric": "multichip_samples_per_sec", "value": 650.0,
+        "spread": 0.05,
+        "rows": [
+            {"workload": "weak_d8",
+             "fsdp": {"samples_per_sec": 650.0, "step_ms": 49.0,
+                      "params_bytes_per_chip": 52296,
+                      "opt_state_bytes_per_chip": 104596},
+             "replicated": {"samples_per_sec": 280.0, "step_ms": 114.0,
+                            "params_bytes_per_chip": 400392,
+                            "opt_state_bytes_per_chip": 800788}},
+            {"workload": "strong_d2",
+             "fsdp": {"samples_per_sec": 1320.0}},
+        ]}
+    s = benchgate.series_from_line(line)
+    k = "multichip_samples_per_sec.weak_d8.fsdp_samples_per_sec"
+    assert s[k] == {"value": 650.0, "spread": 0.05,
+                    "direction": "higher", "unit": "samples/s"}
+    assert s["multichip_samples_per_sec.weak_d8"
+             ".replicated_samples_per_sec"]["value"] == 280.0
+    assert s["multichip_samples_per_sec.strong_d2"
+             ".fsdp_samples_per_sec"]["direction"] == "higher"
+    # informational columns stay out of the gate
+    assert not [k for k in s if "bytes" in k or "step_ms" in k]
+
+
 def test_error_line_produces_no_series():
     assert benchgate.series_from_line(
         {"metric": "x", "error": "boom"}) == {}
@@ -280,6 +311,39 @@ def test_committed_baseline_gate_trips_on_2x_slowed_row(tmp_path):
     assert rc == 2
     after = REGISTRY.counter("bench_regressions_total").total()
     assert after - before >= len(slowed_series)
+
+
+def test_committed_baseline_carries_multichip_series():
+    """The FSDP scaling lane is part of the committed artifact: one
+    weak-scaling row per chip count, the strong-scaling rows, and the
+    replicated A/B at the widest mesh — all gated higher-better."""
+    doc = _committed()
+    keys = [k for k in doc["series"] if k.startswith("multichip")]
+    assert "multichip_samples_per_sec" in keys
+    for tag in ("weak_d1", "weak_d8", "strong_d1"):
+        assert (f"multichip_samples_per_sec.{tag}"
+                f".fsdp_samples_per_sec") in keys
+    assert ("multichip_samples_per_sec.weak_d8"
+            ".replicated_samples_per_sec") in keys
+    assert all(doc["series"][k]["direction"] == "higher" for k in keys)
+    line = next(l for l in doc["lines"]
+                if l["metric"] == "multichip_samples_per_sec")
+    assert line["kill_switch_equal"] is True
+    assert line["fsdp_hbm_win"] >= 4.0      # the acceptance floor
+    d8 = next(r for r in line["rows"] if r["workload"] == "weak_d8")
+    assert d8["fsdp"]["params_bytes_per_chip"] * 4 <= \
+        d8["replicated"]["params_bytes_per_chip"]
+
+
+def test_live_multichip_lane_passes_committed_gate():
+    """THE acceptance shape: actually run the FSDP weak/strong scaling
+    lane over the virtual-device mesh and hold it against the
+    committed baseline — a change that tanks sharded throughput (or
+    breaks the in-lane kill-switch contract, which raises) fails
+    tier-1 here."""
+    rc = _bench_main(["--only", "multichip", "--multichip_small",
+                      "--baseline", BASELINE, "--check"])
+    assert rc == 0
 
 
 def test_check_without_baseline_is_an_argparse_error(tmp_path):

@@ -1,0 +1,393 @@
+"""FSDP over the ``data`` axis (ISSUE 17): the actually-sharded train
+step, sharded optimizer state, sharded checkpoints, and the per-chip
+HBM win the gauges must show directly.
+
+Contracts pinned here:
+
+1. **Rule plumbing** — ``fsdp_spec`` largest-divisible-dim heuristic,
+   ``match_partition_rules``, and the ``spec_for`` rank-fallthrough
+   ``warn_once`` (a typo'd table must not silently replicate a 10^8-row
+   embedding).
+2. **Committed zoo tables** (``parallel/rule_tables.py``) — zero
+   error-severity ``check_sharding`` findings on a data=8 mesh for all
+   four families, and zero PT-SHARD static-lint findings.
+3. **The sharded step** — params AND Adam slots land sharded on an
+   8-virtual-device mesh; per-chip ``hbm_category_bytes{params,
+   opt_state}`` drop ≥4× vs replicated (the ISSUE's acceptance gauge);
+   the fixed-seed loss trajectory is IDENTICAL to replicated; buffer
+   donation survives FSDP (old params/opt deleted after a step).
+4. **Kill switch** — ``--fsdp=false``, and ``--fsdp`` on a 1-chip mesh,
+   are byte-for-byte the replicated program.
+5. **Sharded checkpoints** — per-shard files digest-covered by the
+   format-2 manifest; roundtrips reshard across mesh shapes (8→1,
+   1→8, 4×2→8); a bit-flip in ONE shard file quarantines the whole
+   dir and resume lands on the previous valid checkpoint.
+
+Everything runs on the conftest's 8-virtual-CPU-device backend — no
+TPU needed, same GSPMD partitioner.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.analysis import engine, netcheck
+from paddle_tpu.config.model_config import OptimizationConfig
+from paddle_tpu.core.device import DATA_AXIS, build_mesh, set_mesh
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.network import NeuralNetwork
+from paddle_tpu.models import (lstm_text_classifier,
+                               transformer_text_classifier)
+from paddle_tpu.models.image import resnet_cifar10
+from paddle_tpu.parallel import (ShardingRules, ZOO_FSDP_RULES,
+                                 fsdp_spec, match_partition_rules,
+                                 param_dims_of, transformer_fsdp_rules)
+from paddle_tpu.testing import fault
+from paddle_tpu.trainer.checkpoint import (latest_valid_checkpoint,
+                                           load_manifest,
+                                           verify_checkpoint)
+from paddle_tpu.trainer.trainer import Trainer
+import paddle_tpu.observe.memory as omem
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RULE_TABLES_PY = os.path.join(os.path.dirname(HERE), "paddle_tpu",
+                              "parallel", "rule_tables.py")
+
+# transformer-zoo shapes with every rule-table dim divisible by 8 —
+# the acceptance model (embedding + attention + ffn + cls all shard)
+T, D, HEADS, L, F, V, B = 8, 64, 2, 1, 128, 512, 16
+
+
+def _transformer_trainer(n_devices=8, fsdp=True, batch=B, seed=0,
+                         mesh=None):
+    if mesh is None:
+        mesh = build_mesh({"data": n_devices},
+                          jax.devices()[:n_devices])
+    set_mesh(mesh)
+    cfg = transformer_text_classifier(
+        vocab_size=V, model_dim=D, num_heads=HEADS, num_layers=L,
+        ffn_dim=F, num_classes=2, max_len=T)
+    tr = Trainer(NeuralNetwork(cfg), opt_config=OptimizationConfig(
+        learning_method="adam", learning_rate=1e-3,
+        gradient_clipping_threshold=25.0), mesh=mesh, seed=0,
+        fsdp=fsdp, fsdp_rules=transformer_fsdp_rules())
+    rng = np.random.RandomState(seed)
+    feed = {"data": SequenceBatch(
+                jax.numpy.asarray(
+                    rng.randint(0, V, (batch, T)).astype(np.int32)),
+                jax.numpy.asarray(np.full((batch,), T, np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (batch,)).astype(np.int32))}
+    return tr, feed
+
+
+def _sharded_param_names(tr):
+    return [name for name, leaf in tr.params.items()
+            if any(ax is not None for ax in leaf.sharding.spec)]
+
+
+# ===================================================== rule plumbing
+def test_fsdp_spec_shards_largest_divisible_dim():
+    assert fsdp_spec((1024, 30), 8) == P(DATA_AXIS, None)
+    # dim0 indivisible → next-largest divisible dim wins
+    assert fsdp_spec((30, 1024), 8) == P(None, DATA_AXIS)
+    # nothing divides → replicated, never a compile failure
+    assert fsdp_spec((7, 5), 8) == P()
+    # below min_size: replication is cheaper than gather traffic
+    assert fsdp_spec((8, 8), 8, min_size=1024) == P()
+    assert fsdp_spec((8, 8), 8, min_size=1) == P(DATA_AXIS, None)
+    assert fsdp_spec((), 8) == P()
+
+
+def test_match_partition_rules_resolves_per_name():
+    rules = transformer_fsdp_rules()
+    dims = {"___embedding_1__.w0": (V, D),
+            "_attn0._ln_q.wbias": (D,),
+            "_ffn0_in.w0": (D, F)}
+    out = match_partition_rules(rules, dims)
+    assert out["___embedding_1__.w0"] == P(DATA_AXIS, None)
+    assert out["_attn0._ln_q.wbias"] == P()
+    assert out["_ffn0_in.w0"] == P(None, DATA_AXIS)
+
+
+def test_spec_for_rank_fallthrough_warns_once():
+    """Satellite 1: a matching rule whose spec rank exceeds the param's
+    falls through to the next rule (or replication) AND says so once —
+    silent replication of a fat embedding is the bug class."""
+    from paddle_tpu.utils.logger import _warned, reset_warn_once
+
+    reset_warn_once()
+    rules = ShardingRules([(r"emb", P(None, DATA_AXIS)),
+                           (r".", P())])
+    # rank-1 param: the 2-entry spec can't apply — next rule (P())
+    assert rules.spec_for("emb.w0", 1) == P()
+    key = [k for k in _warned if k.startswith("sharding.rank_excluded")]
+    assert len(key) == 1 and "emb.w0" in key[0]
+    # second resolve: same fallthrough, no new warning key
+    assert rules.spec_for("emb.w0", 1) == P()
+    assert len([k for k in _warned
+                if k.startswith("sharding.rank_excluded")]) == 1
+    # the rule still applies at full rank
+    assert rules.spec_for("emb.w0", 2) == P(None, DATA_AXIS)
+
+
+# ================================================ committed zoo tables
+def _zoo_param_dims():
+    """Representative parameter trees per family, at dims where every
+    table entry's sharded axis divides an 8-way mesh."""
+    dims = {}
+    dims["transformer"] = param_dims_of(NeuralNetwork(
+        transformer_text_classifier(vocab_size=V, model_dim=D,
+                                    num_heads=HEADS, num_layers=L,
+                                    ffn_dim=F, num_classes=2,
+                                    max_len=T)))
+    dims["lstm"] = param_dims_of(NeuralNetwork(
+        lstm_text_classifier(vocab_size=1024, embed_dim=64,
+                             hidden_size=64, lstm_num=2,
+                             num_classes=2)))
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data.feeder import dense_vector, integer_value
+    with dsl.config_scope():
+        img = dsl.data("image", dense_vector(3 * 32 * 32),
+                       height=32, width=32)
+        cost = dsl.classification_cost(
+            resnet_cifar10(img, depth=20, num_classes=10),
+            dsl.data("label", integer_value(10)))
+        dims["resnet"] = param_dims_of(NeuralNetwork(
+            dsl.topology(cost)))
+    # ctr/recommender: demo/ctr/train.py's shapes — one fat
+    # sparse-updated embedding plus a small dense tower
+    dims["ctr"] = {"_slot_emb.w0": [100000, 16],
+                   "_fc_wide.w0": [13, 16],
+                   "_fc_wide.wbias": [16],
+                   "_fc_1.w0": [16, 32],
+                   "_fc_2.w0": [48, 32],
+                   "_ctr_head.w0": [32, 2],
+                   "_ctr_head.wbias": [2]}
+    return dims
+
+
+def test_zoo_rule_tables_verify_clean_on_8way_mesh():
+    """Every committed family table resolves against its family's real
+    parameter tree with ZERO error-severity findings at data=8 — the
+    pod-compile-failure class (unknown axis, indivisible dim) is caught
+    here, in milliseconds."""
+    dims_by_family = _zoo_param_dims()
+    for family, rules_fn in ZOO_FSDP_RULES.items():
+        issues = rules_fn().verify(dims_by_family[family],
+                                   {"data": 8})
+        errs = netcheck.errors(issues)
+        assert not errs, (family,
+                          [e.render() for e in errs])
+
+
+def test_zoo_rule_tables_actually_shard_the_big_params():
+    """The tables must DO something: in each family the dominant
+    parameters resolve to a sharded spec, not accidental replication."""
+    dims_by_family = _zoo_param_dims()
+    for family, rules_fn in ZOO_FSDP_RULES.items():
+        rules = rules_fn()
+        sharded_elems = total_elems = 0
+        for name, dims in dims_by_family[family].items():
+            n = int(np.prod(dims)) if dims else 1
+            total_elems += n
+            if any(ax is not None
+                   for ax in rules.spec_for(name, len(dims))):
+                sharded_elems += n
+        assert sharded_elems / total_elems > 0.5, family
+
+
+def test_rule_tables_pt_shard_lint_zero_findings():
+    """Satellite 5: the committed tables are PT-SHARD-clean (patterns
+    compile, no duplicate/shadowed rules, string axes only)."""
+    res = engine.run([RULE_TABLES_PY], rules=["PT-SHARD"])
+    assert res.findings == []
+
+
+def test_zoo_fsdp_rules_unknown_family_raises():
+    from paddle_tpu.parallel import zoo_fsdp_rules
+
+    with pytest.raises(KeyError) as ei:
+        zoo_fsdp_rules("diffusion")
+    assert "transformer" in str(ei.value)
+
+
+# ==================================================== the sharded step
+def test_fsdp_places_params_and_adam_slots_sharded():
+    tr, feed = _transformer_trainer(fsdp=True)
+    tr.train_one_batch(feed)
+    sharded = _sharded_param_names(tr)
+    # embedding, position table, attn w0/wo, both ffn mats, cls head
+    assert len(sharded) >= 7, sharded
+    assert any("embedding" in n for n in sharded)
+    # Adam slots: every param-shaped moment leaf carries its param's
+    # sharding — the optimizer-state half of the memory win
+    count, slots = tr.opt_state
+    p_leaves = jax.tree_util.tree_leaves(tr.params)
+    n_sharded_slots = 0
+    for p, slot in zip(p_leaves, slots):
+        for leaf in jax.tree_util.tree_leaves(slot):
+            if np.shape(leaf) == np.shape(p) \
+                    and any(ax is not None for ax in leaf.sharding.spec):
+                n_sharded_slots += 1
+    assert n_sharded_slots >= 2 * len(sharded) - 2, n_sharded_slots
+
+
+def test_fsdp_per_chip_hbm_gauges_show_4x_win():
+    """THE acceptance gauge: per-chip ``hbm_category_bytes{params}`` +
+    ``{opt_state}`` under FSDP on 8 chips must be ≥4× below the
+    replicated figures, read off the same metrics surface production
+    scrapes."""
+    from paddle_tpu.observe import REGISTRY
+
+    tr_f, feed_f = _transformer_trainer(fsdp=True)
+    tr_f.train_one_batch(feed_f)
+    omem.sample(tr_f, feed_f)
+    g = REGISTRY.gauge("hbm_category_bytes")
+    f_params = g.value(category="params")
+    f_opt = g.value(category="opt_state")
+
+    tr_r, feed_r = _transformer_trainer(fsdp=False)
+    tr_r.train_one_batch(feed_r)
+    omem.sample(tr_r, feed_r)
+    r_params = g.value(category="params")
+    r_opt = g.value(category="opt_state")
+
+    assert f_params > 0 and f_opt > 0
+    assert r_params / f_params >= 4.0, (r_params, f_params)
+    assert r_opt / f_opt >= 4.0, (r_opt, f_opt)
+    assert (r_params + r_opt) / (f_params + f_opt) >= 4.0
+
+
+def test_fsdp_loss_trajectory_matches_replicated():
+    """Sharding is a layout decision, not a numerics decision: the
+    fixed-seed loss trajectory matches the replicated run to float32
+    reduction-order tolerance (reduce-scatter sums partial grads in a
+    different association than the dense all-reduce — bit-exactness
+    across that boundary is a property no partitioner promises; the
+    byte-for-byte contract lives on the 1-chip kill-switch test
+    below)."""
+    tr_f, feed = _transformer_trainer(fsdp=True)
+    tr_r, _ = _transformer_trainer(fsdp=False)
+    losses_f = [float(tr_f.train_one_batch(feed)) for _ in range(5)]
+    losses_r = [float(tr_r.train_one_batch(feed)) for _ in range(5)]
+    np.testing.assert_allclose(losses_f, losses_r, rtol=2e-5, atol=1e-7)
+
+
+def test_fsdp_kill_switch_single_chip_byte_identical():
+    """``--fsdp`` on a 1-chip mesh resolves to None — the SAME program
+    as ``--fsdp=false``, byte-for-byte params after 3 steps."""
+    tr_on, feed = _transformer_trainer(n_devices=1, fsdp=True)
+    tr_off, _ = _transformer_trainer(n_devices=1, fsdp=False)
+    assert tr_on._resolve_fsdp() is None
+    for _ in range(3):
+        tr_on.train_one_batch(feed)
+        tr_off.train_one_batch(feed)
+    for name in tr_on.params:
+        assert np.array_equal(np.asarray(tr_on.params[name]),
+                              np.asarray(tr_off.params[name])), name
+
+
+def test_fsdp_preserves_buffer_donation():
+    """Satellite 2: donate_argnums still covers (params, opt_state,
+    buffers) under FSDP — after a step the PREVIOUS params/opt buffers
+    are deleted (donated to XLA), not silently copied."""
+    tr, feed = _transformer_trainer(fsdp=True)
+    tr.train_one_batch(feed)                     # build + place + step
+    old_params = dict(tr.params)
+    old_slots = jax.tree_util.tree_leaves(tr.opt_state[1])
+    tr.train_one_batch(feed)
+    donated = [v.is_deleted() for v in old_params.values()]
+    assert all(donated), donated
+    assert all(leaf.is_deleted() for leaf in old_slots)
+    # and the new state is still sharded (donation didn't reshard)
+    assert len(_sharded_param_names(tr)) >= 7
+
+
+# ================================================= sharded checkpoints
+def _save_one(tr, feed, tmp_path, steps=2, pass_id=0):
+    for _ in range(steps):
+        tr.train_one_batch(feed)
+    save_dir = str(tmp_path / "ckpt")
+    return save_dir, tr.save(save_dir, pass_id)
+
+
+def test_sharded_ckpt_manifest_covers_shard_files(tmp_path):
+    tr, feed = _transformer_trainer(fsdp=True)
+    _, ckpt = _save_one(tr, feed, tmp_path)
+    names = os.listdir(ckpt)
+    shard_files = [n for n in names if ".shard-" in n]
+    assert any(n.startswith("params.shard-") for n in shard_files)
+    assert any(n.startswith("opt_state.shard-") for n in shard_files)
+    man = load_manifest(ckpt)
+    # format-2 digests cover EVERY shard file — a flipped bit anywhere
+    # fails verification, same contract as the dense layout
+    assert man["format"] >= 2
+    for n in shard_files:
+        assert n in man["files"], n
+    assert "params" in man["shards"] and "opt_state" in man["shards"]
+    for ent in man["shards"]["params"].values():
+        assert ent["shards"] == 8 and "dim" in ent
+    assert verify_checkpoint(ckpt)
+
+
+@pytest.mark.parametrize("src,dst", [
+    ({"data": 8}, {"data": 1}),           # shrink to a single chip
+    ({"data": 1}, {"data": 8}),           # grow: dense ckpt → FSDP
+    ({"data": 4, "model": 2}, {"data": 8}),   # reshape across axes
+])
+def test_sharded_ckpt_reshards_across_mesh_shapes(tmp_path, src, dst):
+    """A checkpoint saved on ANY mesh shape loads on any other: the
+    loader reassembles full arrays from the shard files and the target
+    trainer re-places them for ITS mesh (params byte-equal, opt state
+    byte-equal, and sharded again when the target runs FSDP)."""
+    n_src = int(np.prod(list(src.values())))
+    mesh_src = build_mesh(src, jax.devices()[:n_src])
+    tr, feed = _transformer_trainer(fsdp=True, mesh=mesh_src)
+    _, ckpt = _save_one(tr, feed, tmp_path)
+
+    n_dst = int(np.prod(list(dst.values())))
+    tr2, _ = _transformer_trainer(n_devices=n_dst, fsdp=True, seed=7)
+    tr2.train_one_batch(feed)      # place + step once before loading
+    tr2.load(ckpt)
+    for name in tr.params:
+        assert np.array_equal(np.asarray(tr.params[name]),
+                              np.asarray(tr2.params[name])), name
+    for a, b in zip(jax.tree_util.tree_leaves(tr.opt_state),
+                    jax.tree_util.tree_leaves(tr2.opt_state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    if n_dst > 1:
+        # resharding-on-load: the loaded state is SHARDED on the new
+        # mesh, not replicated leftovers
+        assert len(_sharded_param_names(tr2)) >= 7
+    # training continues from the restored state
+    assert np.isfinite(float(tr2.train_one_batch(feed)))
+
+
+def test_sharded_ckpt_bitflip_one_shard_quarantines_whole_dir(tmp_path):
+    """Satellite 3 chaos leg: ONE flipped byte in ONE shard file fails
+    digest verification for the whole checkpoint; resume quarantines
+    the dir as .corrupt-* and lands on the previous valid one."""
+    tr, feed = _transformer_trainer(fsdp=True)
+    save_dir, _ = _save_one(tr, feed, tmp_path, pass_id=0)
+    tr.train_one_batch(feed)
+    tr.save(save_dir, 1)
+    newest = os.path.join(save_dir, "pass-00001")
+    shard_file = sorted(n for n in os.listdir(newest)
+                        if n.startswith("params.shard-"))[3]
+    fault.corrupt_checkpoint(newest, fname=shard_file, mode="bitflip")
+    assert verify_checkpoint(newest) is False
+
+    tr2, _ = _transformer_trainer(fsdp=True, seed=99)
+    tr2.train_one_batch(feed)
+    assert tr2.resume(save_dir) is True
+    assert tr2.samples_seen == load_manifest(
+        os.path.join(save_dir, "pass-00000"))["samples_seen"]
+    dirs = sorted(os.listdir(save_dir))
+    assert ".corrupt-pass-00001" in dirs and "pass-00001" not in dirs
+    # the quarantined dir still holds the damaged shard for forensics
+    assert shard_file in os.listdir(
+        os.path.join(save_dir, ".corrupt-pass-00001"))
