@@ -1135,6 +1135,243 @@ def bench_multichip():
         _dev._mesh = saved_mesh
 
 
+# --sparse_small: CPU-runnable shapes for the sparse embedding lane
+SPARSE_SMALL = False
+
+
+def _sparse_shapes():
+    """(lookup-scan table sizes, lookup dim, ids per lookup batch,
+    train table rows, train emb dim, train batch, train seq len, scan
+    iters) for the sparse embedding lane.  The lookup dim stays
+    lane-aligned (128) so the TPU dispatch would take the kernel path
+    at these exact shapes; the train rows hit the 10⁶ CPU scale the
+    exchange A/B is pinned at (10⁷ at bench scale)."""
+    if SPARSE_SMALL:
+        return (10 ** 4, 10 ** 5, 10 ** 6), 128, 4096, 10 ** 6, 16, \
+            256, 8, 8
+    return (10 ** 5, 10 ** 6, 10 ** 7), 128, 8192, 10 ** 7, 64, \
+        1024, 16, 32
+
+
+def _sparse_trainer(vocab, emb_dim, batch, seq_len, mesh, seed=0):
+    """One ctr-shaped trainer (sparse_update embedding → sum-pool →
+    relu tower → softmax head) over ``vocab`` rows, plus its
+    fixed-seed feed.  Whether the step runs the sparse exchange or the
+    legacy dense gradient is read off ``--sparse_grads`` at build
+    time — the lane flips the flag between constructions for the A/B."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.config.dsl import config_scope
+    from paddle_tpu.config.model_config import OptimizationConfig
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.data.feeder import integer_value, \
+        integer_value_sequence
+    from paddle_tpu.layers.network import NeuralNetwork
+    from paddle_tpu.trainer.trainer import Trainer
+
+    with config_scope():
+        x = dsl.data("ids", integer_value_sequence(vocab))
+        lab = dsl.data("label", integer_value(2))
+        emb = dsl.embedding(x, size=emb_dim, param_attr=dsl.ParamAttr(
+            name="_slot_emb.w", sparse_update=True, initial_std=0.02))
+        pooled = dsl.pooling(emb, pooling_type=dsl.SumPooling())
+        tower = dsl.fc(pooled, size=32, act=dsl.ReluActivation())
+        pred = dsl.fc(tower, size=2, act=dsl.SoftmaxActivation())
+        cfg = dsl.topology(dsl.classification_cost(pred, lab))
+    trainer = Trainer(
+        NeuralNetwork(cfg),
+        opt_config=OptimizationConfig(
+            learning_method="adam", learning_rate=1e-3,
+            gradient_clipping_threshold=25.0),
+        mesh=mesh, seed=0)
+    rng = np.random.RandomState(seed)
+    feed = {"ids": SequenceBatch(
+                jax.numpy.asarray(rng.randint(
+                    0, vocab, (batch, seq_len)).astype(np.int32)),
+                jax.numpy.asarray(np.full((batch,), seq_len,
+                                          np.int32))),
+            "label": jax.numpy.asarray(
+                rng.randint(0, 2, (batch,)).astype(np.int32))}
+    return trainer, feed
+
+
+def _time_call_ms(fn, *args, reps=5):
+    """Median warm-call wall ms of ``fn(*args)`` (first call pays the
+    compile and is dropped)."""
+    jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def _sparse_lookup_row(vocab, dim, n_ids):
+    """One lookup-throughput row at table size ``vocab``: the
+    production sparse composite (dedup → touched-row gather → inverse
+    lookup, ``parallel/sparse.py``) against the dense ``take`` over
+    the raw id stream.  Gate keys are ``lookups_per_sec`` only —
+    ``call_ms`` rides along informationally (a second ``_ms`` series
+    per mode would shadow it in the gate)."""
+    from paddle_tpu.ops import pallas_embedding as pemb
+    from paddle_tpu.parallel import sparse as psparse
+
+    rng = np.random.RandomState(vocab % (2 ** 31))
+    table = jax.numpy.zeros((vocab, dim), jax.numpy.float32)
+    ids = jax.numpy.asarray(
+        rng.randint(0, vocab, (n_ids,)).astype(np.int32))
+
+    @jax.jit
+    def sparse_lookup(table, ids):
+        rows = psparse.unique_rows_sorted(ids, n_ids, vocab)
+        block = pemb.gather_rows(table, rows)
+        return psparse.lookup_rows(rows, block, ids)
+
+    @jax.jit
+    def dense_lookup(table, ids):
+        return jax.numpy.take(table, ids, axis=0, mode="clip")
+
+    sparse_ms = _time_call_ms(sparse_lookup, table, ids)
+    dense_ms = _time_call_ms(dense_lookup, table, ids)
+    row = {
+        "workload": f"lookup_v{vocab}",
+        "sparse": {
+            "lookups_per_sec": round(n_ids / (sparse_ms / 1e3), 1),
+            "call_ms": round(sparse_ms, 4)},
+        "dense": {
+            "lookups_per_sec": round(n_ids / (dense_ms / 1e3), 1),
+            "call_ms": round(dense_ms, 4)},
+    }
+    del table
+    return row
+
+
+def bench_sparse():
+    """Sparse embedding lane (`--only sparse`, round 22).
+
+    Three measurements on one line:
+
+    - lookup throughput vs table size — the production sparse
+      composite (``unique_rows_sorted`` → ``gather_rows`` →
+      ``lookup_rows``) against the dense ``take`` over the raw id
+      stream, one row per table size (on CPU the gather dispatch takes
+      the ``no_tpu`` XLA fallback; the shapes are exactly the kernel's
+      capable shapes so a TPU run exercises the Pallas path);
+    - the dense-vs-sparse-exchange TRAIN A/B at the 10⁶-row CPU scale
+      (``--sparse_grads`` flipped between trainer builds):
+      samples/sec plus ``exchanged_grad_bytes`` — the fixed-capacity
+      (rows, values) payload against the dense [V, D] gradient — with
+      the traffic win stamped on the line;
+    - the kill-switch contracts, replayed every run and raising (=
+      lane failure) on violation: ``--embedding_kernel`` on/off
+      byte-identical gathers (interpret-mode kernel vs XLA at tiny
+      shapes), and ``--sparse_grads`` on/off parameter trajectories
+      rtol-close after 3 fixed-seed steps (close, not bit-equal: the
+      scatter-add accumulates in a different order than the dense
+      update).
+    """
+    from paddle_tpu.core import device as _dev
+    from paddle_tpu.core.device import build_mesh, set_mesh
+    from paddle_tpu.ops import pallas_embedding as pemb
+    from paddle_tpu.parallel import sparse as psparse
+
+    scan, dim, n_ids, v_train, emb_dim, batch, seq_len, iters = \
+        _sparse_shapes()
+    saved_mesh = _dev._mesh
+    saved_sparse = bool(FLAGS.sparse_grads)
+    try:
+        mesh = build_mesh({"data": 1}, jax.devices()[:1])
+        set_mesh(mesh)
+        rows = [_sparse_lookup_row(v, dim, n_ids) for v in scan]
+
+        # ---- train A/B: sparse exchange vs legacy dense gradient
+        FLAGS.set("sparse_grads", True)
+        tr_sp, feed = _sparse_trainer(v_train, emb_dim, batch,
+                                      seq_len, mesh)
+        sp_ms, _ = _scan_time_ms(tr_sp, feed, iters=iters)
+        cap = batch * seq_len       # auto capacity = batch id count
+        sp_bytes = psparse.exchange_payload_bytes(cap, emb_dim)
+        FLAGS.set("sparse_grads", False)
+        tr_d, _ = _sparse_trainer(v_train, emb_dim, batch, seq_len,
+                                  mesh)
+        d_ms, _ = _scan_time_ms(tr_d, feed, iters=iters)
+        d_bytes = v_train * emb_dim * 4
+        rows.append({
+            "workload": f"train_v{v_train}",
+            "sparse": {
+                "samples_per_sec": round(batch / (sp_ms / 1e3), 3),
+                "step_ms": round(sp_ms, 3),
+                "exchanged_grad_bytes": int(sp_bytes)},
+            "dense": {
+                "samples_per_sec": round(batch / (d_ms / 1e3), 3),
+                "step_ms": round(d_ms, 3),
+                "exchanged_grad_bytes": int(d_bytes)},
+        })
+        del tr_d
+
+        # ---- kill-switch contracts (every run, violation raises)
+        rng = np.random.RandomState(7)
+        t_small = jax.numpy.asarray(
+            rng.randn(32, 128).astype(np.float32))
+        r_small = jax.numpy.asarray(
+            rng.randint(0, 32, (8,)).astype(np.int32))
+        FLAGS.set("embedding_kernel_interpret", True)
+        a = np.asarray(pemb.gather_rows(t_small, r_small))
+        FLAGS.set("embedding_kernel", False)
+        b = np.asarray(pemb.gather_rows(t_small, r_small))
+        FLAGS.set("embedding_kernel", True)
+        FLAGS.set("embedding_kernel_interpret", False)
+        if not np.array_equal(a, b):
+            raise RuntimeError(
+                "embedding kernel kill-switch contract violated: "
+                "--embedding_kernel on/off gathers differ")
+
+        FLAGS.set("sparse_grads", True)
+        eq_sp, eq_feed = _sparse_trainer(1024, emb_dim, 16, seq_len,
+                                         mesh, seed=3)
+        FLAGS.set("sparse_grads", False)
+        eq_d, _ = _sparse_trainer(1024, emb_dim, 16, seq_len, mesh,
+                                  seed=3)
+        for _ in range(3):
+            eq_sp.train_one_batch(eq_feed)
+            eq_d.train_one_batch(eq_feed)
+        for name in eq_sp.params:
+            if not np.allclose(np.asarray(eq_sp.params[name]),
+                               np.asarray(eq_d.params[name]),
+                               rtol=1e-4, atol=1e-6):
+                raise RuntimeError(
+                    "sparse exchange equivalence violated: "
+                    f"--sparse_grads on/off diverged on {name!r}")
+        FLAGS.set("sparse_grads", True)
+
+        headline = rows[len(scan) - 1]["sparse"]["lookups_per_sec"]
+        line = _with_band({
+            "metric": "sparse_embedding",
+            "value": headline,
+            "unit": f"lookups/s (sparse composite, {scan[-1]:.0e}-row "
+                    f"table, d={dim}, {n_ids} ids)",
+            "scale": "small" if SPARSE_SMALL else "bench",
+            "rows": rows,
+            "exchange_traffic_win": round(d_bytes / sp_bytes, 1),
+            "kill_switch_equal": True,
+            "sparse_dense_equiv": True,
+            "vs_baseline_note": "reference ships sparse tables to "
+                                "parameter servers row by row "
+                                "(SparseRemoteParameterUpdater); here "
+                                "the fixed-capacity (rows, values) "
+                                "exchange rides the jitted step and "
+                                "the dense [V, D] gradient is never "
+                                "materialized",
+            "perf_stamp_of": f"train_v{v_train}.sparse",
+        }, values=[headline])
+        return _finish(line, "sparse_train", tr_sp, feed,
+                       step_ms=sp_ms)
+    finally:
+        FLAGS.set("sparse_grads", saved_sparse)
+        _dev._mesh = saved_mesh
+
+
 # --pipeline_small: CPU-runnable shapes for the prefetch A/B lane
 PIPELINE_SMALL = False
 
@@ -1832,7 +2069,7 @@ def main(argv=None):
 
     lanes = ["lstm", "resnet", "seq2seq", "attention", "lstm1280",
              "lstm2048", "pipeline", "precision", "observe", "serving",
-             "multichip"]
+             "multichip", "sparse"]
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     help="run a subset of lanes (comma-separated): "
@@ -1862,6 +2099,13 @@ def main(argv=None):
                          "runnable transformer shapes over the virtual-"
                          "device mesh (the JSON line records "
                          "scale='small'); default is bench scale")
+    ap.add_argument("--sparse_small", action="store_true",
+                    help="run the sparse embedding lane (lookup "
+                         "throughput vs table size + the dense-vs-"
+                         "sparse-exchange train A/B) at CPU-runnable "
+                         "shapes — 10\u2076-row train table (the JSON "
+                         "line records scale='small'); default is the "
+                         "bench 10\u2077 scale")
     ap.add_argument("--profile", action="store_true",
                     help="dump a jax.profiler trace of a few production "
                          "train steps per workload (see --profile_dir); "
@@ -1938,6 +2182,9 @@ def main(argv=None):
     if args.multichip_small:
         global MULTICHIP_SMALL
         MULTICHIP_SMALL = True
+    if args.sparse_small:
+        global SPARSE_SMALL
+        SPARSE_SMALL = True
     if args.attribution_diff:
         # pure-host replay of two committed dumps: no workload runs, no
         # backend touched — the kernel-PR verification loop stays fast
@@ -1968,7 +2215,8 @@ def main(argv=None):
                    "precision": bench_precision,
                    "observe": bench_observe,
                    "serving": bench_serving,
-                   "multichip": bench_multichip}
+                   "multichip": bench_multichip,
+                   "sparse": bench_sparse}
         order = [t.strip() for t in args.only.split(",") if t.strip()] \
             if args.only else lanes
         unknown = [t for t in order if t not in benches]
@@ -1995,7 +2243,8 @@ def main(argv=None):
                             or PRECISION_SMALL
                             or ATTENTION_SMALL
                             or SERVING_SMALL
-                            or MULTICHIP_SMALL else "bench"),
+                            or MULTICHIP_SMALL
+                            or SPARSE_SMALL else "bench"),
                   "argv": sys.argv[1:] if argv is None else list(argv)})
         print(f"wrote baseline {args.write_baseline} "
               f"({len(doc['series'])} series)", file=sys.stderr,

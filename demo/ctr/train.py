@@ -1,12 +1,19 @@
 """Sparse wide&deep CTR demo (reference ``demo/ctr`` + the sparse
 large-model workload, BASELINE config 5): dense features through the wide
-path, 26 categorical slots through a large embedding table (the
-sparse-remote-parameter-equivalent — shard it over the ``model`` mesh axis
-via ``paddle_tpu.parallel.tp_rules`` on multi-chip).
+path, 26 categorical slots through a production-shaped embedding table
+(the sparse-remote-parameter-equivalent).  At the default 10⁷ rows the
+table + Adam moments are ~1.9 GB — the dense [V, D] gradient path is the
+wrong tool at this scale, so training leans on ``--sparse_grads`` (the
+fixed-capacity (rows, values) exchange, on by default) and on multi-chip
+the table row-shards over the ``data`` axis via
+``paddle_tpu.parallel.ctr_fsdp_rules`` (``--fsdp``); the per-chip
+``hbm_category_bytes{params,opt_state}`` gauges read the memory win.
 
-Run: python demo/ctr/train.py
+Run: python demo/ctr/train.py [--table_rows N]
+(env ``CTR_TABLE_ROWS`` also works — tests/benches size down with it)
 """
 
+import argparse
 import os
 import sys
 
@@ -20,11 +27,19 @@ from paddle_tpu.config.dsl import config_scope
 from paddle_tpu.trainer import events as ev
 from paddle_tpu.utils import FLAGS
 
-SPARSE_DIM = 10 ** 5   # demo-sized vocabulary
+SPARSE_DIM = int(os.environ.get("CTR_TABLE_ROWS", 10 ** 7))
 SLOTS = 26
 
 
 def main():
+    global SPARSE_DIM
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--table_rows", type=int, default=SPARSE_DIM,
+                    help="embedding table rows (default: 10**7, "
+                         "production-shaped)")
+    args, rest = ap.parse_known_args()
+    FLAGS.parse_argv(rest)
+    SPARSE_DIM = args.table_rows
     FLAGS.set("save_dir", "")
     with config_scope():
         dense = paddle.layer.data("dense",

@@ -7,7 +7,17 @@ title text-conv) each combine into a tower; rating = 5·cos(usr, mov),
 trained with square error against the MovieLens-1M ratings
 (``paddle_tpu.v2.dataset.movielens``, synthetic surrogate offline).
 
-Run: python demo/recommender/train.py [--passes N]
+The user-id and movie-id tables are the demo's memory: they are NAMED
+sparse-update params (``_usr_emb.w`` / ``_mov_emb.w``) so their
+gradients ride the fixed-capacity sparse exchange (``--sparse_grads``,
+on by default) and, under ``--fsdp``, their rows shard over the
+``data`` axis via ``paddle_tpu.parallel.recommender_fsdp_rules``; the
+per-chip ``hbm_category_bytes{params,opt_state}`` gauges read the win.
+``--table_rows`` sizes both id spaces production-shaped (default
+10⁷; env ``RECO_TABLE_ROWS`` also works — 0 keeps the real
+MovieLens-1M ranges).
+
+Run: python demo/recommender/train.py [--passes N] [--table_rows N]
 """
 
 import argparse
@@ -33,7 +43,11 @@ def build_towers(meta, emb: int = 32, hidden: int = 64):
     job = paddle.layer.data(
         "job", paddle.data_type.integer_value(meta["max_job"] + 1))
     usr = paddle.layer.concat([
-        paddle.layer.fc(paddle.layer.embedding(uid, size=emb), size=emb),
+        paddle.layer.fc(paddle.layer.embedding(
+            uid, size=emb,
+            param_attr=paddle.attr.ParamAttr(
+                name="_usr_emb.w", sparse_update=True,
+                initial_std=0.02)), size=emb),
         paddle.layer.fc(paddle.layer.embedding(gender, size=8), size=8),
         paddle.layer.fc(paddle.layer.embedding(age, size=8), size=8),
         paddle.layer.fc(paddle.layer.embedding(job, size=8), size=8),
@@ -54,7 +68,11 @@ def build_towers(meta, emb: int = 32, hidden: int = 64):
         paddle.layer.embedding(title, size=emb),
         context_len=3, hidden_size=emb)
     mov = paddle.layer.concat([
-        paddle.layer.fc(paddle.layer.embedding(mid, size=emb), size=emb),
+        paddle.layer.fc(paddle.layer.embedding(
+            mid, size=emb,
+            param_attr=paddle.attr.ParamAttr(
+                name="_mov_emb.w", sparse_update=True,
+                initial_std=0.02)), size=emb),
         cat_bag, title_conv])
     mov = paddle.layer.fc(mov, size=hidden,
                           act=paddle.activation.Tanh())
@@ -92,13 +110,29 @@ def build_model(meta, emb: int = 32, hidden: int = 64):
 
 
 def main():
+    from paddle_tpu.utils import FLAGS
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--passes", type=int, default=4)
     ap.add_argument("--batch", type=int, default=64)
-    args = ap.parse_args()
+    ap.add_argument("--table_rows", type=int,
+                    default=int(os.environ.get("RECO_TABLE_ROWS",
+                                               10 ** 7)),
+                    help="user-id/movie-id table rows (default: 10**7, "
+                         "production-shaped; 0 = real MovieLens "
+                         "ranges)")
+    args, rest = ap.parse_known_args()
+    FLAGS.parse_argv(rest)
+
+    meta = movielens_meta()
+    if args.table_rows:
+        # production-shaped id spaces: the real ratings only touch the
+        # low ranges, which is exactly the sparse-exchange workload
+        meta["max_uid"] = args.table_rows - 1
+        meta["max_mid"] = args.table_rows - 1
 
     with config_scope():
-        cost, _score = build_model(movielens_meta())
+        cost, _score = build_model(meta)
         trainer = paddle.trainer.SGD(
             cost,
             update_equation=paddle.optimizer.Adam(learning_rate=1e-3))

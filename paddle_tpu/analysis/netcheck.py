@@ -222,6 +222,16 @@ def _check_graph(graph: _Graph, issues: List[Issue]) -> None:
                      f"(producer is {ins[0].dtype}, shape "
                      f"{ins[0].shape_str()}) — ids must be an index "
                      "input", kind="dtype")
+            vocab = attrs.get("vocab_size")
+            if vocab and ins and ins[0] is not None \
+                    and ins[0].dtype == "int" and ins[0].size \
+                    and vocab < ins[0].size:
+                _err(issues, graph, layer,
+                     f"embedding table has {vocab} rows but its id "
+                     f"input declares a {ins[0].size}-value range — "
+                     f"ids {vocab}..{ins[0].size - 1} index past the "
+                     "table (size the table to the id space, or the "
+                     "lookup clips/zero-fills silently)")
             out = ValueInfo(size=layer.size or None,
                             seq=bool(ins and ins[0] and ins[0].seq))
         elif lt in CONV_TYPES:

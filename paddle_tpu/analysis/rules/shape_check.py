@@ -325,8 +325,17 @@ class _Extractor:
             vals = self._values(_kw(node, "input", 0)
                                 or ast.Constant(None))
             size = _int_of(_kw(node, "size", 1) or ast.Constant(None), C)
+            # table geometry, mirroring dsl.embedding's own derivation
+            # (vocab_size kwarg, else the id input's declared range) —
+            # netcheck's PT-SHAPE embedding branch judges it against
+            # the producer's id space
+            vs_node = _kw(node, "vocab_size")
+            vocab = _int_of(vs_node, C) if vs_node is not None else None
+            if vocab is None and vals and vals[0] is not None:
+                vocab = vals[0].size or None
+            attrs = {"vocab_size": vocab} if vocab else {}
             rec = _Rec(self._fresh("embedding"), "embedding", size,
-                       self._input_names(vals[:1], line), {}, line)
+                       self._input_names(vals[:1], line), attrs, line)
             self.records.append(rec)
             return rec
         if name in ("img_conv", "img_conv_layer"):

@@ -26,6 +26,7 @@ import numpy as np
 from ..config.model_config import LayerConfig, ParameterConfig
 from ..core.sequence import SequenceBatch, like, value_of
 from ..ops import embedding_ops, math_ops, sequence_ops
+from ..parallel import sparse as psparse
 from ..utils import ConfigError, enforce
 from .base import ForwardContext, Layer, register_layer
 
@@ -115,11 +116,18 @@ class EmbeddingLayer(Layer):
                                   sharded=self.conf.attrs.get("sharded", False))]
 
     def forward(self, params, inputs, ctx):
-        table = params[self.weight_name(0)]
+        name = self.weight_name(0)
         ids = value_of(inputs[0])
-        out = embedding_ops.lookup_table(table, ids)
-        if ids.ndim >= 2 and out.ndim == ids.ndim + 1:
-            pass
+        entry = psparse.exchange_entry(name)
+        if entry is not None:
+            # sparse gradient exchange: this trace routes the lookup
+            # through the batch's prefetched (rows, block) pair, so
+            # autodiff yields a [K, D] block cotangent instead of the
+            # dense [V, D] table gradient (parallel/sparse.py)
+            rows, block = entry
+            out = psparse.lookup_rows(rows, block, ids)
+        else:
+            out = embedding_ops.lookup_table(params[name], ids)
         return self.finalize(like(inputs[0], out), ctx)
 
 

@@ -86,13 +86,14 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     # pipeline lane is a bounded ratio that would never see it).
     # Modes: pipeline sync/prefetch, precision fp32/bf16, attention
     # dense/legacy/block-skip + padded/packed + paged decode, serving
-    # continuous/sequential, multichip fsdp/replicated.
+    # continuous/sequential, multichip fsdp/replicated, embedding
+    # sparse (lookup kernel + sparse-exchange training, dense A/B).
     for row in line.get("rows", ()):
         tag = row.get("workload", "?")
         for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
                      "legacy", "block_skip", "padded", "packed",
                      "decode", "continuous", "sequential",
-                     "fsdp", "replicated"):
+                     "fsdp", "replicated", "sparse"):
             sub = row.get(mode) or {}
             for key, unit, direction, suffix in (
                     ("ms_per_batch", "ms/batch", "lower", "_ms"),
@@ -105,7 +106,12 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
                     # higher-better; per-chip hbm fields are
                     # informational (not series keys)
                     ("samples_per_sec", "samples/s", "higher",
-                     "_samples_per_sec")):
+                     "_samples_per_sec"),
+                    # sparse embedding lane: lookup throughput gates
+                    # higher-better; exchanged_grad_bytes and call_ms
+                    # are informational (not series keys)
+                    ("lookups_per_sec", "lookups/s", "higher",
+                     "_lookups_per_sec")):
                 v = sub.get(key)
                 if v is not None:
                     out[f"{metric}.{tag}.{mode}{suffix}"] = {

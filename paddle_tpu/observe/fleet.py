@@ -906,9 +906,60 @@ def _fmt_bytes(v: Optional[float]) -> str:
     return f"{v:.1f}PB"
 
 
+def _parse_prom_labels(label_str: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in label_str.strip("{}").split(","):
+        k, eq, v = part.partition("=")
+        if eq:
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def summarize_label_families(prom: str, threshold: int = 8,
+                             top_k: int = 3) -> List[str]:
+    """Label-explosion guard for the watch console: a gauge family
+    with ``threshold``-or-more labelled series — the per-(category,
+    shard) ``hbm_shard_bytes`` family on a wide mesh is the canonical
+    case — renders as ONE summary line (series count, total, top-k
+    series by value) instead of one console line per series.  Families
+    below the threshold are left to their usual columns."""
+    fams: Dict[str, List[Any]] = {}
+    for line in prom.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        fam = line.split("{", 1)[0]
+        labels, _, value = line.rpartition("} ")
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        fams.setdefault(fam, []).append(
+            (_parse_prom_labels(labels.split("{", 1)[-1]), v))
+    out: List[str] = []
+    for fam in sorted(fams):
+        series = fams[fam]
+        if len(series) < threshold:
+            continue
+        fmt = _fmt_bytes if fam.endswith("_bytes") \
+            else (lambda v: f"{v:g}")
+        top = sorted(series, key=lambda s: -s[1])[:top_k]
+        cells = []
+        for labels, v in top:
+            key = ",".join(f"{k}={labels[k]}" for k in sorted(labels)
+                           if k != "proc")
+            cells.append(f"{key}={fmt(v)}")
+        total = sum(v for _, v in series)
+        out.append(f"{fam}  {len(series)} series  total={fmt(total)}"
+                   f"  top: " + "  ".join(cells))
+    return out
+
+
 def render_watch(rollup_doc: Dict[str, Any],
-                 rows: List[Dict[str, Any]]) -> str:
-    """The live-console frame: one aligned row per process."""
+                 rows: List[Dict[str, Any]],
+                 family_summaries: Optional[List[str]] = None) -> str:
+    """The live-console frame: one aligned row per process, plus a
+    top-k summary line per label-explosion gauge family (see
+    :func:`summarize_label_families`)."""
     hdr = (f"fleet: {rollup_doc['status']}  "
            + "  ".join(f"{k}={v}" for k, v in
                        sorted(rollup_doc.get("counts", {}).items())
@@ -933,6 +984,11 @@ def render_watch(rollup_doc: Dict[str, Any],
     for row in table:
         lines.append("  ".join(c.ljust(w) for c, w in
                                zip(row, widths)).rstrip())
+    if family_summaries:
+        lines.append("")
+        lines.append("label-wide families (one line per family, "
+                     "top series by value):")
+        lines.extend(f"  {s}" for s in family_summaries)
     return "\n".join(lines)
 
 
@@ -959,12 +1015,14 @@ def watch_once(addr: str) -> str:
             "health": p.get("health", "?"),
         })
     # headline metrics come from the merged exposition
+    summaries: List[str] = []
     try:
         prom = _http_get(addr, "/fleet/metrics").decode()
         _fill_headline_from_prometheus(prom, rows)
+        summaries = summarize_label_families(prom)
     except OSError:
         pass
-    return render_watch(roll, rows)
+    return render_watch(roll, rows, family_summaries=summaries)
 
 
 def _fill_headline_from_prometheus(prom: str,

@@ -164,11 +164,60 @@ def account(trainer=None, feed=None,
     }
 
 
+def shard_categories(trainer=None, feed=None) -> Dict[str, Dict[str, int]]:
+    """Per-SHARD category attribution: category → {device id (str) →
+    bytes resident on that device}.
+
+    The per-device refinement of :func:`account`'s per-chip figures —
+    on a row-sharded 10⁷-row embedding table each ``data``-axis shard
+    carries ``V/n`` rows, and this is where an imbalance (a replicated
+    stray slot, an indivisible-dim degrade) becomes visible per chip.
+    Replicated leaves contribute their full size to EVERY device they
+    live on, sharded leaves one shard each.  One series per (category,
+    device) — a label-explosion family by design; consoles summarize
+    it top-k (``fleet --watch``) rather than one line per series."""
+    out: Dict[str, Dict[str, int]] = {}
+    for name, tree in _category_trees(trainer, feed).items():
+        if tree is None:
+            continue
+        per_dev: Dict[str, int] = {}
+        import jax
+        for leaf in jax.tree_util.tree_leaves(tree):
+            sh = getattr(leaf, "sharding", None)
+            if sh is None or not getattr(leaf, "nbytes", 0):
+                continue
+            nb = per_chip_bytes(leaf)
+            try:
+                devices = sorted(sh.device_set, key=lambda d: d.id)
+            except Exception:  # noqa: BLE001 — telemetry never kills
+                continue
+            for d in devices:
+                key = str(d.id)
+                per_dev[key] = per_dev.get(key, 0) + nb
+        if per_dev:
+            out[name] = per_dev
+    return out
+
+
 def sample(trainer=None, feed=None, device=None) -> Dict[str, Any]:
     """Take one accounting snapshot AND publish it as gauges — the
     ``/metrics`` surface (``hbm_in_use_bytes``, ``hbm_peak_bytes``,
-    ``hbm_category_bytes{category=...}``).  Returns the snapshot."""
+    ``hbm_category_bytes{category=...}``, and the per-device
+    ``hbm_shard_bytes{category,shard}`` family).  Returns the
+    snapshot (with the per-shard breakdown under ``"shards"``)."""
     snap = account(trainer, feed, device)
+    shards = shard_categories(trainer, feed)
+    snap["shards"] = shards
+    if shards:
+        sg = gauge("hbm_shard_bytes",
+                   "bytes of each accounting category resident on each "
+                   "device (sharded leaves count one shard per device, "
+                   "replicated leaves their full size on every device) "
+                   "— a per-(category,shard) label-explosion family; "
+                   "consoles render it as a top-k summary")
+        for cname, per_dev in shards.items():
+            for dev_id, nbytes in per_dev.items():
+                sg.set(nbytes, category=cname, shard=dev_id)
     gauge("hbm_in_use_bytes",
           "device memory currently in use (allocator stats when the "
           "backend reports them, else total live committed arrays)"

@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.dtypes import record_op_precision
 from .math_ops import matmul
 from .registry import register_op
 
@@ -24,11 +25,18 @@ from .registry import register_op
 @register_op("lookup_table", "embedding")
 def lookup_table(table: jax.Array, ids: jax.Array,
                  padding_idx: Optional[int] = None) -> jax.Array:
-    """table [V, D], ids [...] int → [..., D]."""
-    out = jnp.take(table, ids.astype(jnp.int32), axis=0)
+    """table [V, D], ids [...] int → [..., D].
+
+    ``padding_idx`` rows read as zeros; the mask is folded into the
+    gather itself (padding ids are routed one past the table and the
+    fill value supplies the zeros) rather than a full-width ``where``
+    over the [..., D] output.
+    """
+    record_op_precision("lookup_table")
+    ids32 = ids.astype(jnp.int32)
     if padding_idx is not None:
-        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
-    return out
+        ids32 = jnp.where(ids == padding_idx, table.shape[0], ids32)
+    return jnp.take(table, ids32, axis=0, mode="fill", fill_value=0)
 
 
 @register_op("nce")

@@ -23,10 +23,14 @@ from .sharding import (ShardingRules, tp_rules, shard_params,
                        spec_shard_info, FSDP_MIN_SIZE)  # noqa: F401
 from .rule_tables import (lstm_fsdp_rules, resnet_fsdp_rules,
                           transformer_fsdp_rules, ctr_fsdp_rules,
+                          recommender_fsdp_rules,
                           zoo_fsdp_rules, ZOO_FSDP_RULES)  # noqa: F401
 from .ring_attention import (ring_attention, ulysses_attention,
                              full_attention)  # noqa: F401
 from ..ops.pallas_attention import flash_attention  # noqa: F401
 from .sparse import (SelectedRows, unique_rows, row_gather,
                      row_scatter_add, row_scatter_set, touched_row_mask,
-                     prefetch_rows, sparse_embedding_lookup)  # noqa: F401
+                     prefetch_rows, sparse_embedding_lookup,
+                     unique_rows_sorted, lookup_rows, exchange_scope,
+                     exchange_entry,
+                     exchange_payload_bytes)  # noqa: F401

@@ -91,6 +91,19 @@ def ctr_fsdp_rules() -> ShardingRules:
     ])
 
 
+def recommender_fsdp_rules() -> ShardingRules:
+    """Dual-tower MovieLens recommender (``demo/recommender``): the
+    user-id and movie-id tables (``_usr_emb.w`` / ``_mov_emb.w``, the
+    demo's named sparse-update params) carry the memory at production
+    row counts — shard their rows over ``data``; the feature embeddings
+    (gender/age/job/category bags — tens of rows) and the KiB-scale
+    tower fcs replicate, both too small to divide across topologies."""
+    return ShardingRules([
+        (r"_(usr|mov)_emb\.w\d*$", P(DATA_AXIS, None)),
+        (r".", P()),
+    ])
+
+
 #: Zoo-family name → table factory, the lookup ``Trainer(fsdp=True,
 #: fsdp_rules=zoo_fsdp_rules("transformer"))`` callers use.
 ZOO_FSDP_RULES = {
@@ -98,6 +111,7 @@ ZOO_FSDP_RULES = {
     "resnet": resnet_fsdp_rules,
     "transformer": transformer_fsdp_rules,
     "ctr": ctr_fsdp_rules,
+    "recommender": recommender_fsdp_rules,
 }
 
 

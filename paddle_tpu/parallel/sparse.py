@@ -34,6 +34,7 @@ Two composition styles:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -130,3 +131,73 @@ def sparse_embedding_lookup(block: jax.Array, inverse: jax.Array
     """Second half of the prefetch pattern: ids-shaped embedding from the
     prefetched block ([K, D] → inverse.shape + [D])."""
     return jnp.take(block, inverse, axis=0)
+
+
+# ================================================ sparse gradient exchange
+#
+# The trainer-side composition of the fixed-capacity prefetch: with
+# ``--sparse_grads`` the jitted train step dedupes each embedding
+# table's batch ids ONCE (``unique_rows_sorted``), gathers the touched
+# rows into a [K, D] block (Pallas scalar-prefetch kernel on capable
+# shapes, ops/pallas_embedding.py), and routes every lookup of that
+# table through the block via a TRACE-TIME substitution scope the
+# EmbeddingLayer consults.  Autodiff then yields a [K, D] cotangent —
+# the (rows, values) exchange payload; the dense [V, D] gradient is
+# never materialized, and on a row-sharded table the update is a
+# shard-local scatter-add instead of a dense all-reduce (the
+# SparseRemoteParameterUpdater exchange, expressed in SPMD).
+
+def unique_rows_sorted(ids: jax.Array, capacity: int, height: int
+                       ) -> jax.Array:
+    """Dedupe ids into a SORTED fixed-capacity row set padded with
+    ``height`` (one-past-the-end, kept sorted — unlike the -1 padding
+    of :func:`unique_rows`) so presence lookups are a searchsorted.
+    Pad rows route out of bounds in every scatter (mode='drop') and
+    clamp in every gather, exactly like -1 pads."""
+    flat = ids.astype(jnp.int32).ravel()
+    return jnp.unique(flat, size=capacity, fill_value=height)
+
+
+def lookup_rows(rows: jax.Array, block: jax.Array, ids: jax.Array
+                ) -> jax.Array:
+    """ids-shaped embedding from a sorted row set + gathered block:
+    ``block[searchsorted(rows, ids)]``.  Exact whenever every id is
+    present in ``rows`` (the exchange scope's contract — rows came from
+    this batch's own ids at sufficient capacity)."""
+    pos = jnp.searchsorted(rows, ids.astype(jnp.int32))
+    return jnp.take(block, pos.reshape(ids.shape), axis=0)
+
+
+# Param name → (rows, block) substitution entries for the CURRENT trace.
+# A trace-time construct by design: the trainer pushes the scope while
+# the step jaxpr is built and the EmbeddingLayer reads it during the
+# same trace; the finally rebalances even when tracing aborts.
+_exchange_scope: list = []
+
+
+@contextlib.contextmanager
+def exchange_scope(entries):
+    """Route embedding lookups of the named tables through their
+    prefetched ``(rows, block)`` pair for the duration of this trace
+    (``entries``: param name → (rows [K], block [K, D]))."""
+    _exchange_scope.append(dict(entries))  # ptpu: lint-ok[PT-TRACE] trace-time stack
+    try:
+        yield
+    finally:
+        _exchange_scope.pop()              # ptpu: lint-ok[PT-TRACE] trace-time stack
+
+
+def exchange_entry(param_name: str):
+    """The active ``(rows, block)`` substitution for ``param_name``,
+    else None (the dense lookup path)."""
+    if _exchange_scope:
+        return _exchange_scope[-1].get(param_name)
+    return None
+
+
+def exchange_payload_bytes(capacity: int, dim: int,
+                           value_itemsize: int = 4) -> int:
+    """Exchanged gradient bytes of one (rows, values) pair: K int32
+    row indices + the [K, D] value block — the traffic a dense
+    all-reduce of the [V, D] gradient is replaced by."""
+    return int(capacity) * (4 + int(dim) * int(value_itemsize))

@@ -135,6 +135,7 @@ class Trainer:
         self._lr_scales = network.lr_scales(self.params)
         self._train_step = None
         self._eval_step = None
+        self._sparse_plan = None
         self.samples_seen = 0
         # --roofline_dump: first-batch feed retained for the one-shot
         # compiled-step cost attribution at the end of pass 0
@@ -368,6 +369,150 @@ class Trainer:
             extras += (self._health.ensure_state(),)
         return extras
 
+    def _param_leaf_names(self):
+        """Flattened parameter leaf names in tree order — the alignment
+        contract of the optimizer slot list (``Optimizer.init``) that
+        ``_place_opt_state`` / ``_fsdp_constrainers`` also rely on."""
+        return [".".join(str(k.key) if hasattr(k, "key") else str(k)
+                         for k in path)
+                for path, _ in jax.tree_util.tree_flatten_with_path(
+                    self.params)[0]]
+
+    def _sparse_exchange_plan(self):
+        """Sparse gradient exchange plan (``--sparse_grads``): param
+        name → list of feed keys (data-layer names) whose ids touch it.
+
+        A ``ParameterConfig(sparse_update=True)`` table is ELIGIBLE when
+        every use is a top-level embedding layer fed directly by a data
+        layer — then the step can dedupe the batch's ids up front,
+        gather the touched rows once (ops/pallas_embedding.py), route
+        every lookup through the block (``parallel.sparse
+        .exchange_scope``), and autodiff hands back a fixed-capacity
+        ``(rows, values)`` gradient instead of the dense ``[V, D]`` one.
+        Ineligible tables (shared into non-embedding layers, inside
+        recurrent groups, pruned, health telemetry active) keep the
+        legacy in-graph lazy masking, with a one-time notice."""
+        if self._sparse_plan is None:
+            self._sparse_plan = self._build_sparse_exchange_plan()
+        return self._sparse_plan
+
+    def _build_sparse_exchange_plan(self):
+        from ..utils import warn_once
+        net = self.network
+        sparse_names = {n for n, s in net.param_specs.items()
+                        if s.sparse_update and n not in net.static_params}
+        if not FLAGS.sparse_grads or not sparse_names:
+            return {}
+        if self._health is not None:
+            # the health aux consumes the dense per-param grads dict;
+            # a missing-table grads tree would hole its telemetry
+            warn_once(
+                "trainer.sparse_exchange:health",
+                "sparse gradient exchange disabled while "
+                "--health_interval is active (health telemetry reads "
+                "dense per-parameter gradients) — sparse tables take "
+                "the lazy dense-masked update", logger=log)
+            return {}
+        leaf_names = self._param_leaf_names()
+        group_specs = {
+            spec.name
+            for g in net.groups.values()
+            for lyr in g.layers.values()
+            for spec in lyr.param_specs()}
+        plan = {}
+        for name in sorted(sparse_names):
+            uses = [lyr for lyr in net.layers.values()
+                    if any(spec.name == name
+                           for spec in lyr.param_specs())]
+            eligible = (
+                name not in (self._prune_masks or {})
+                and name not in group_specs
+                and leaf_names.count(name) == 1
+                and np.ndim(self.params.get(name)) == 2
+                and bool(uses)
+                and all(lyr.conf.type == "embedding"
+                        and lyr.conf.inputs
+                        and lyr.conf.inputs[0].input_layer_name
+                        in net.data_layers
+                        for lyr in uses))
+            if not eligible:
+                warn_once(
+                    f"trainer.sparse_exchange:ineligible:{name}",
+                    "sparse_update parameter %r is not exchange-"
+                    "eligible (used outside a directly-fed embedding "
+                    "layer, pruned, or not a plain [V, D] leaf) — "
+                    "taking the lazy dense-masked update", name,
+                    logger=log)
+                continue
+            plan[name] = sorted({lyr.conf.inputs[0].input_layer_name
+                                 for lyr in uses})
+        return plan
+
+    def _exchange_prefetch(self, ex_plan, params, feed):
+        """Per-table batch prefetch inside the jitted step: dedupe this
+        batch's ids into a sorted fixed-capacity row set and gather the
+        touched rows (Pallas scalar-prefetch kernel on capable
+        single-device shapes).  Capacity is ``--sparse_grad_rows`` or
+        the batch's total id count — which can never overflow."""
+        from ..core.sequence import value_of
+        from ..ops import pallas_embedding
+        from ..parallel import sparse as psparse
+        # host flag, read at trace time by design (capacity is static)
+        cap_flag = int(FLAGS.sparse_grad_rows)  # ptpu: lint-ok[PT-TRACE]
+        # the kernel is a single-device program; on a real mesh the
+        # (possibly row-sharded) gather stays with the SPMD partitioner
+        allow_kernel = self.mesh.devices.size <= 1
+        ex_rows, ex_blocks = {}, {}
+        with jax.named_scope("sparse_prefetch"):
+            for name, keys in ex_plan.items():
+                table = params[name]
+                ids = jnp.concatenate(
+                    [value_of(feed[k]).astype(jnp.int32).ravel()
+                     for k in keys])
+                # .size is the static shape product, not a traced value
+                cap = cap_flag if cap_flag > 0 \
+                    else int(ids.size)  # ptpu: lint-ok[PT-TRACE]
+                rows = psparse.unique_rows_sorted(
+                    ids, cap, table.shape[0])
+                ex_rows[name] = rows
+                ex_blocks[name] = pallas_embedding.gather_rows(
+                    table, rows, allow_kernel=allow_kernel)
+        return ex_rows, ex_blocks
+
+    def _exchange_apply(self, ex_plan, params, opt_state, ex_rows,
+                        block_grads, dense_new, dense_opt_new, lr):
+        """Apply the exchanged ``(rows, values)`` gradients as per-table
+        O(K) row updates (``Optimizer.apply_rows`` — touched rows' value
+        and moments only, the SelectedRows optimizer-kernel contract)
+        and splice the results back into the full param dict / slot
+        list.  Rows whose exchanged gradient is exactly zero are routed
+        out of bounds first, mirroring the dense path's inferred
+        ``touched_row_mask`` — so ``--sparse_grads`` on/off agree on
+        which rows a batch may move (weight decay included)."""
+        count, slots = opt_state
+        new_count, dense_slots_new = dense_opt_new
+        leaf_names = self._param_leaf_names()
+        new_params = dict(dense_new)
+        slot_new_by_name = {}
+        for name in ex_plan:
+            table = params[name]
+            rows = ex_rows[name]
+            row_g = block_grads[name].astype(table.dtype)
+            touched = jnp.any(row_g != 0,
+                              axis=tuple(range(1, row_g.ndim)))
+            rows_eff = jnp.where(touched, rows, table.shape[0])
+            sc = self._lr_scales.get(name) if self._lr_scales else None
+            eff_lr = lr if sc is None else lr * sc
+            slot = slots[leaf_names.index(name)]
+            new_table, (_, new_slot) = self.optimizer.apply_rows(
+                table, rows_eff, row_g, (count, slot), eff_lr)
+            new_params[name] = new_table
+            slot_new_by_name[name] = new_slot
+        dense_iter = iter(dense_slots_new)
+        slots_out = [slot_new_by_name[n] if n in slot_new_by_name
+                     else next(dense_iter) for n in leaf_names]
+        return new_params, (new_count, slots_out)
+
     @staticmethod
     def _dealias(tree):
         """Copy every leaf so no two donated leaves share a buffer (JAX
@@ -389,10 +534,18 @@ class Trainer:
         # SparseRowMatrix.h:29; see paddle_tpu/parallel/sparse.py)
         sparse_names = {n for n, s in net.param_specs.items()
                         if s.sparse_update}
+        # --sparse_grads: exchange-eligible tables leave the dense
+        # gradient entirely — their grads travel as fixed-capacity
+        # (rows, values) pairs and apply as O(K) row updates; the rest
+        # of sparse_names keeps the lazy masked path
+        ex_plan = self._sparse_exchange_plan()
+        sparse_names -= set(ex_plan)
+        leaf_names = self._param_leaf_names() if ex_plan else []
 
         hs = self._health
         hs_stats = hs.stats_fn() if hs is not None else None
         from ..observe import health as _health
+        from ..parallel import sparse as psparse
         # FSDP (--fsdp): sharding constraints threaded through the step
         # (identity closures when inactive — the legacy jaxpr)
         c_params, c_opt = self._fsdp_constrainers()
@@ -404,8 +557,28 @@ class Trainer:
                     p, feed, buffers, is_training=True, rng=rng)
                 return loss, new_buffers
 
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            if ex_plan:
+                ex_rows, ex_blocks = self._exchange_prefetch(
+                    ex_plan, params, feed)
+
+                def loss_fn_ex(p, blocks):
+                    full = dict(p)
+                    for n in ex_plan:
+                        full[n] = jax.lax.stop_gradient(params[n])
+                    with psparse.exchange_scope(
+                            {n: (ex_rows[n], blocks[n])
+                             for n in ex_plan}):
+                        return loss_fn(full)
+
+                dense_p = {n: v for n, v in params.items()
+                           if n not in ex_plan}
+                (loss, new_buffers), (grads, block_grads) = \
+                    jax.value_and_grad(loss_fn_ex, (0, 1),
+                                       has_aux=True)(dense_p, ex_blocks)
+            else:
+                (loss, new_buffers), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                block_grads = {}
             grads = c_params(grads)
             if self._prune_masks:
                 from ..optimizer.hooks import apply_prune_grads
@@ -421,9 +594,23 @@ class Trainer:
             # region in the compiled-step cost attribution
             # (observe/costmodel.py) instead of polluting layer regions
             with jax.named_scope("optimizer"):
-                new_params, new_opt = opt.apply(params, grads, opt_state,
-                                                lr, lr_scales,
-                                                sparse_masks=masks)
+                if ex_plan:
+                    count, slots = opt_state
+                    dense_slots = [s for n, s in zip(leaf_names, slots)
+                                   if n not in ex_plan]
+                    dense_scales = {n: lr_scales[n] for n in grads} \
+                        if lr_scales is not None else None
+                    new_dense, dense_opt_new = opt.apply(
+                        {n: params[n] for n in grads}, grads,
+                        (count, dense_slots), lr, dense_scales,
+                        sparse_masks=masks)
+                    new_params, new_opt = self._exchange_apply(
+                        ex_plan, params, opt_state, ex_rows,
+                        block_grads, new_dense, dense_opt_new, lr)
+                else:
+                    new_params, new_opt = opt.apply(
+                        params, grads, opt_state, lr, lr_scales,
+                        sparse_masks=masks)
                 new_params = c_params(new_params)
                 new_opt = c_opt(new_opt)
             if hs_stats is not None:
@@ -461,6 +648,14 @@ class Trainer:
         lr_scales = self._lr_scales
         sparse_names = {n for n, s in net.param_specs.items()
                         if s.sparse_update}
+        # --sparse_grads: exchange-eligible tables leave the dense
+        # gradient — see _build_train_step; the bf16 wrinkles are that
+        # the [K, D] block grads unscale in fp32 with the dense grads
+        # and join the finite sweep, and the fp32 master table updates
+        # through apply_rows behind the same skipped-step select
+        ex_plan = self._sparse_exchange_plan()
+        sparse_names -= set(ex_plan)
+        leaf_names = self._param_leaf_names() if ex_plan else []
         pol = policy_for("bf16")
         cd = pol.compute_dtype
         growth_interval = FLAGS.loss_scale_growth_interval
@@ -473,6 +668,7 @@ class Trainer:
         hs = self._health
         hs_stats = hs.stats_fn() if hs is not None else None
         from ..observe import health as _health
+        from ..parallel import sparse as psparse
         # FSDP (--fsdp): sharding constraints threaded through the step
         # (identity closures when inactive — the legacy jaxpr)
         c_params, c_opt = self._fsdp_constrainers()
@@ -491,8 +687,36 @@ class Trainer:
                     return (loss * ls_state.scale.astype(loss.dtype),
                             (loss, new_buffers))
 
-                (_, (loss, new_buffers)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
+                if ex_plan:
+                    # prefetch gathers from the fp32 master table; the
+                    # blocks cast to compute dtype inside loss_fn so
+                    # their cotangents come back fp32, like the masters'
+                    ex_rows, ex_blocks = self._exchange_prefetch(
+                        ex_plan, params, feed)
+
+                    def loss_fn_ex(p, blocks):
+                        full = dict(p)
+                        for n in ex_plan:
+                            full[n] = jax.lax.stop_gradient(params[n])
+                        cb = cast_compute(blocks)
+                        with psparse.exchange_scope(
+                                {n: (ex_rows[n], cb[n])
+                                 for n in ex_plan}):
+                            return loss_fn(full)
+
+                    dense_p = {n: v for n, v in params.items()
+                               if n not in ex_plan}
+                    (_, (loss, new_buffers)), (grads, block_grads) = \
+                        jax.value_and_grad(loss_fn_ex, (0, 1),
+                                           has_aux=True)(dense_p,
+                                                         ex_blocks)
+                    block_grads = ls.unscale(block_grads,
+                                             ls_state.scale)
+                else:
+                    (_, (loss, new_buffers)), grads = \
+                        jax.value_and_grad(loss_fn,
+                                           has_aux=True)(params)
+                    block_grads = {}
             grads = ls.unscale(grads, ls_state.scale)
             grads = c_params(grads)
             if hs_stats is not None:
@@ -503,7 +727,7 @@ class Trainer:
                 finite = ls.all_finite_from_counts(nf_counts)
             else:
                 nf_counts = None
-                finite = ls.all_finite(grads)
+                finite = ls.all_finite((grads, block_grads))
             if self._prune_masks:
                 from ..optimizer.hooks import apply_prune_grads
                 grads = apply_prune_grads(grads, self._prune_masks)
@@ -515,9 +739,23 @@ class Trainer:
                              else None)
                          for n, g in grads.items()}
             with jax.named_scope("optimizer"):
-                new_params, new_opt = opt.apply(params, grads, opt_state,
-                                                lr, lr_scales,
-                                                sparse_masks=masks)
+                if ex_plan:
+                    count, slots = opt_state
+                    dense_slots = [s for n, s in zip(leaf_names, slots)
+                                   if n not in ex_plan]
+                    dense_scales = {n: lr_scales[n] for n in grads} \
+                        if lr_scales is not None else None
+                    new_dense, dense_opt_new = opt.apply(
+                        {n: params[n] for n in grads}, grads,
+                        (count, dense_slots), lr, dense_scales,
+                        sparse_masks=masks)
+                    new_params, new_opt = self._exchange_apply(
+                        ex_plan, params, opt_state, ex_rows,
+                        block_grads, new_dense, dense_opt_new, lr)
+                else:
+                    new_params, new_opt = opt.apply(
+                        params, grads, opt_state, lr, lr_scales,
+                        sparse_masks=masks)
                 new_params = ls.select(finite, new_params, params)
                 new_opt = ls.select(finite, new_opt, opt_state)
                 new_buffers = ls.select(finite, new_buffers, buffers)

@@ -383,6 +383,40 @@ FLAGS.define("serve_slo_ms", 0.0,
              "optional p99 TTFT SLO in milliseconds: when > 0 the "
              "server's /healthz and the bench serving lane report "
              "slo_met from the serve_ttft_seconds reservoir p99")
+FLAGS.define("sparse_grads", True,
+             "sparse gradient exchange for ParamAttr(sparse_update="
+             "True) embedding tables (parallel/sparse.py): the jitted "
+             "train step carries each table's gradient as a fixed-"
+             "capacity (rows, values) pair — batch ids deduped once, "
+             "row cotangents segment-summed by autodiff — and applies "
+             "it as a shard-local scatter-add through "
+             "Optimizer.apply_rows, so the dense [V, D] gradient is "
+             "never materialized or all-reduced.  false is the kill "
+             "switch: the legacy dense gradient + lazy row masking, "
+             "byte-for-byte")
+FLAGS.define("sparse_grad_rows", 0,
+             "fixed row capacity K of the sparse gradient exchange "
+             "per table (the SelectedRows prefetch-buffer budget): "
+             "rows/values ship as [K]/[K, D] whatever the batch "
+             "touches.  0 (default) = auto — the batch's total id "
+             "count, which can never overflow.  A manual K below the "
+             "unique-id count of a batch drops the LARGEST ids from "
+             "the update (jnp.unique keeps the smallest K) — size it "
+             ">= the worst-case unique ids per batch")
+FLAGS.define("embedding_kernel", True,
+             "gather embedding rows through the Pallas scalar-prefetch "
+             "kernel (ops/pallas_embedding.py): the deduped row-index "
+             "table rides the grid spec's scalar prefetch so only "
+             "touched rows are DMA'd HBM->VMEM; false = the plain XLA "
+             "take gather, byte-for-byte, for one-flag revert / A/B "
+             "traffic measurement")
+FLAGS.define("embedding_kernel_interpret", False,
+             "run the Pallas embedding gather in interpret mode on "
+             "non-TPU backends (numerics-contract tests at tiny "
+             "shapes).  Off (default), CPU/GPU dispatch falls back to "
+             "the XLA gather with reason no_tpu — interpret mode "
+             "emulates the grid one step at a time and costs seconds "
+             "per call at production row counts")
 FLAGS.define("mesh_shape", "", "mesh as 'data=8' or 'data=4,model=2' (auto if empty)")
 FLAGS.define("fsdp", False,
              "shard parameters AND optimizer slots over the 'data' "

@@ -36,3 +36,8 @@ def addto_width_mismatch():
     a = dsl.data("a", dense_vector(8))
     b = dsl.data("b", dense_vector(6))
     return dsl.addto([a, b])                                 # 8 vs 6
+
+
+def table_smaller_than_id_space():
+    ids = dsl.data("ids", integer_value(5000))
+    return dsl.embedding(ids, size=16, vocab_size=1000)      # 1000 rows

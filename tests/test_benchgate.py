@@ -335,6 +335,46 @@ def test_committed_baseline_carries_multichip_series():
         d8["replicated"]["params_bytes_per_chip"]
 
 
+def test_committed_baseline_carries_sparse_series():
+    """The sparse embedding lane is part of the committed artifact:
+    lookup throughput per table size (sparse composite vs dense take)
+    and the train A/B at the 10\u2076-row CPU scale, all gated
+    higher-better, with the exchange traffic win and both kill-switch
+    contracts stamped on the line."""
+    doc = _committed()
+    keys = [k for k in doc["series"] if k.startswith("sparse")]
+    assert "sparse_embedding" in keys
+    for v in (10 ** 4, 10 ** 5, 10 ** 6):
+        for mode in ("sparse", "dense"):
+            assert (f"sparse_embedding.lookup_v{v}"
+                    f".{mode}_lookups_per_sec") in keys
+    for mode in ("sparse", "dense"):
+        assert (f"sparse_embedding.train_v1000000"
+                f".{mode}_samples_per_sec") in keys
+    assert all(doc["series"][k]["direction"] == "higher" for k in keys)
+    line = next(l for l in doc["lines"]
+                if l["metric"] == "sparse_embedding")
+    assert line["kill_switch_equal"] is True
+    assert line["sparse_dense_equiv"] is True
+    assert line["exchange_traffic_win"] >= 100.0   # acceptance floor
+    tr = next(r for r in line["rows"]
+              if r["workload"] == "train_v1000000")
+    # the A/B's point: the fixed-capacity exchange ships orders of
+    # magnitude fewer gradient bytes than the dense [V, D] payload
+    assert tr["sparse"]["exchanged_grad_bytes"] * 100 <= \
+        tr["dense"]["exchanged_grad_bytes"]
+
+
+def test_live_sparse_lane_passes_committed_gate():
+    """Acceptance shape: actually run the sparse embedding lane
+    (lookup scan, dense-vs-sparse-exchange train A/B at 10\u2076 rows,
+    kill-switch contracts — which raise in-lane on violation) and hold
+    it against the committed baseline."""
+    rc = _bench_main(["--only", "sparse", "--sparse_small",
+                      "--baseline", BASELINE, "--check"])
+    assert rc == 0
+
+
 def test_live_multichip_lane_passes_committed_gate():
     """THE acceptance shape: actually run the FSDP weak/strong scaling
     lane over the virtual-device mesh and hold it against the

@@ -248,6 +248,33 @@ def test_watch_rows_and_render():
     assert text.splitlines()[0].startswith("fleet: ok")
 
 
+def test_watch_summarizes_label_explosion_families():
+    """The per-(category,shard) hbm_shard_bytes family — 8 devices x
+    several categories — must render as ONE top-k summary line, not a
+    console line per series; small families stay out of the summary."""
+    prom_lines = []
+    for cat in ("params", "opt_state"):
+        for shard in range(8):
+            v = (2 if cat == "opt_state" else 1) * (shard + 1) * 1024
+            prom_lines.append(
+                f'hbm_shard_bytes{{category="{cat}",shard="{shard}",'
+                f'proc="trainer-0"}} {v}')
+    prom_lines.append('hbm_peak_bytes{proc="trainer-0"} 4096')
+    prom = "\n".join(prom_lines)
+    summaries = fleet.summarize_label_families(prom)
+    assert len(summaries) == 1                 # peak gauge: no summary
+    s = summaries[0]
+    assert s.startswith("hbm_shard_bytes") and "16 series" in s
+    # top series is opt_state on the last shard, proc label dropped
+    assert "category=opt_state,shard=7=16.0KB" in s
+    assert "proc" not in s
+    text = fleet.render_watch(
+        {"status": "ok", "counts": {"ok": 1}}, [],
+        family_summaries=summaries)
+    assert "label-wide families" in text
+    assert text.count("hbm_shard_bytes") == 1  # one line, not sixteen
+
+
 # ------------------------------------------------------ pusher ↔ aggregator
 def test_pusher_registration_and_incremental_spans():
     with FleetAggregator(0) as agg:

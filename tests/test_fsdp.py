@@ -167,6 +167,22 @@ def _zoo_param_dims():
                    "_fc_2.w0": [48, 32],
                    "_ctr_head.w0": [32, 2],
                    "_ctr_head.wbias": [2]}
+    # recommender: demo/recommender/train.py's shapes — the named
+    # user/movie id tables carry the memory; feature embeddings
+    # (gender/age/job/cats) and the tower fcs stay replicated (their
+    # row counts don't divide any topology)
+    dims["recommender"] = {"_usr_emb.w0": [80000, 32],
+                           "_mov_emb.w0": [40000, 32],
+                           "___embedding_3__.w0": [2, 8],
+                           "___embedding_5__.w0": [7, 8],
+                           "___embedding_7__.w0": [21, 8],
+                           "___embedding_13__.w0": [40, 32],
+                           "___fc_2__.w0": [32, 32],
+                           "___fc_2__.wbias": [32],
+                           "___fc_10__.w0": [56, 64],
+                           "___fc_10__.wbias": [64],
+                           "___fc_20__.w0": [96, 64],
+                           "___fc_20__.wbias": [64]}
     return dims
 
 

@@ -1,0 +1,381 @@
+"""Sparse gradient exchange (ISSUE 18 tentpole): sparse↔dense
+equivalence, skip-step composition, and the kernel/flag kill switches.
+
+Contracts pinned here:
+
+1. **Trajectory equivalence** — a fixed-seed ctr-shaped run takes the
+   SAME loss/parameter trajectory with ``--sparse_grads`` on and off
+   (rtol-pinned: the exchange path sums row cotangents in a different
+   float order than the dense segment-sum, so bit-identity is the
+   wrong contract — closeness at trainer rtol is).
+2. **bf16 composition** — the exchange rides the loss-scale machinery:
+   a seeded overflow skips the step in BOTH paths (params, slots and
+   the exchanged table bit-unchanged, scale halves), and the post-skip
+   trajectories still agree.
+3. **Untouched rows** — under the exchange, rows outside the batch
+   vocabulary never move, value OR Adam moments, bit-identical (the
+   ``SparseRowMatrix.h`` lazy-update contract, now without the dense
+   gradient ever existing).
+4. **Kill switches, both directions** — ``--sparse_grads=false`` is
+   byte-for-byte the never-eligible (``sparse_update=False``) program;
+   ``--embedding_kernel`` on/off gathers byte-equal rows (interpret
+   kernel vs dense XLA), and the dispatch counter's path/reason labels
+   agree with the tier actually taken (``no_tpu`` off-TPU by default).
+5. **Row-sharded scale** — on the 8-virtual-device mesh the ctr table
+   shards its rows (``zoo_fsdp_rules("ctr")``): per-chip params AND
+   opt-state bytes drop ≥6× vs replicated, the sharded checkpoint
+   digests every shard file and roundtrips byte-equal, and (slow lane)
+   a 10^7-row table trains with the exchange where the replicated
+   dense gradient would be 32× the table.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import config_scope
+from paddle_tpu.config.model_config import OptimizationConfig
+from paddle_tpu.core.device import build_mesh, set_mesh
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.data.feeder import (dense_vector, integer_value,
+                                    integer_value_sequence)
+from paddle_tpu.layers.network import NeuralNetwork
+from paddle_tpu.observe import REGISTRY
+from paddle_tpu.parallel import zoo_fsdp_rules
+from paddle_tpu.trainer.checkpoint import load_manifest, verify_checkpoint
+from paddle_tpu.trainer.trainer import Trainer
+from paddle_tpu.utils import FLAGS
+
+SAVED_FLAGS = ("precision", "loss_scale_init", "loss_scale_growth_interval",
+               "sparse_grads", "sparse_grad_rows", "embedding_kernel",
+               "embedding_kernel_interpret", "save_dir")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    saved = {k: FLAGS.get(k) for k in SAVED_FLAGS}
+    yield
+    for k, v in saved.items():
+        FLAGS.set(k, v)
+
+
+def _ctr_trainer(vocab=64, emb_dim=8, sparse=True, precision="",
+                 lr=1e-2, seed=0, mesh=None, fsdp=None, dense_leg=False):
+    """ctr-shaped model (sparse_update embedding → sum-pool → relu
+    tower → softmax head).  ``dense_leg`` adds a float input into the
+    tower so a feed of ``inf`` can seed a loss-scale overflow (the
+    ids/label inputs are integers — nothing to poison otherwise)."""
+    with config_scope():
+        ids = dsl.data("ids", integer_value_sequence(vocab))
+        lab = dsl.data("label", integer_value(2))
+        emb = dsl.embedding(ids, size=emb_dim, param_attr=dsl.ParamAttr(
+            name="_slot_emb.w", sparse_update=sparse, initial_std=0.1))
+        pooled = dsl.pooling(emb, pooling_type=dsl.SumPooling())
+        tower_in = [pooled, dsl.data("x", dense_vector(4))] \
+            if dense_leg else pooled
+        tower = dsl.fc(tower_in, size=16, act=dsl.ReluActivation())
+        pred = dsl.fc(tower, size=2, act=dsl.SoftmaxActivation())
+        cfg = dsl.topology(dsl.classification_cost(pred, lab))
+    return Trainer(
+        NeuralNetwork(cfg),
+        opt_config=OptimizationConfig(
+            learning_method="adam", learning_rate=lr,
+            gradient_clipping_threshold=25.0, precision=precision),
+        mesh=mesh, seed=seed, fsdp=fsdp,
+        fsdp_rules=zoo_fsdp_rules("ctr") if fsdp else None)
+
+
+def _feed(rng, vocab, batch=8, seq_len=6, dense_leg=False, x_fill=None):
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, seq_len))
+                      .astype(np.int32))
+    f = {"ids": SequenceBatch(
+            ids, jnp.asarray(np.full((batch,), seq_len, np.int32))),
+         "label": jnp.asarray(rng.randint(0, 2, (batch,))
+                              .astype(np.int32))}
+    if dense_leg:
+        x = np.full((batch, 4), x_fill, np.float32) if x_fill is not None \
+            else rng.randn(batch, 4).astype(np.float32)
+        f["x"] = jnp.asarray(x)
+    return f
+
+
+def _bytes(tree):
+    return {str(k): np.asarray(v).tobytes()
+            for k, v in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def _run(trainer, feeds):
+    return [float(trainer.train_one_batch(dict(f))) for f in feeds]
+
+
+def _assert_exchanging(trainer):
+    """Guard: the sparse trainer really took the exchange path (a
+    silently-empty plan would make every A/B below dense-vs-dense)."""
+    assert trainer._sparse_exchange_plan() == {"_slot_emb.w": ["ids"]}
+
+
+# ================================================ trajectory equivalence
+def test_sparse_dense_same_trajectory_fp32():
+    V = 512
+    feeds = [_feed(np.random.RandomState(10 + i), V) for i in range(4)]
+
+    FLAGS.set("sparse_grads", True)
+    tr_sp = _ctr_trainer(vocab=V)
+    loss_sp = _run(tr_sp, feeds)
+    _assert_exchanging(tr_sp)
+
+    FLAGS.set("sparse_grads", False)
+    tr_d = _ctr_trainer(vocab=V)
+    loss_d = _run(tr_d, feeds)
+    assert tr_d._sparse_exchange_plan() == {}
+
+    np.testing.assert_allclose(loss_sp, loss_d, rtol=1e-4)
+    for name in tr_sp.params:
+        np.testing.assert_allclose(
+            np.asarray(tr_sp.params[name]), np.asarray(tr_d.params[name]),
+            rtol=2e-4, atol=1e-6, err_msg=name)
+
+
+def test_sparse_dense_same_trajectory_bf16_with_skip_steps():
+    """bf16 A/B including a seeded overflow: both paths skip the SAME
+    step bit-identically (scale 1024→512), then keep agreeing."""
+    V = 256
+    FLAGS.set("loss_scale_init", 1024.0)
+    good = [_feed(np.random.RandomState(20 + i), V, dense_leg=True)
+            for i in range(3)]
+    bad = dict(good[0])
+    bad["x"] = jnp.full((8, 4), np.inf, jnp.float32)
+
+    snaps = {}
+    for flag in (True, False):
+        FLAGS.set("sparse_grads", flag)
+        tr = _ctr_trainer(vocab=V, precision="bf16", dense_leg=True)
+        warm = float(tr.train_one_batch(dict(good[0])))
+        p0, o0 = _bytes(tr.params), _bytes(tr.opt_state)
+        tr.train_one_batch(bad)                     # seeded overflow
+        assert _bytes(tr.params) == p0, "skipped step mutated params"
+        assert _bytes(tr.opt_state) == o0, "skipped step mutated slots"
+        assert float(tr._ls_state.scale) == 512.0
+        assert int(tr._ls_state.skipped_total) == 1
+        tail = _run(tr, good[1:])
+        snaps[flag] = (warm, tail, tr)
+    _assert_exchanging(snaps[True][2])
+
+    np.testing.assert_allclose(snaps[True][0], snaps[False][0], rtol=1e-3)
+    np.testing.assert_allclose(snaps[True][1], snaps[False][1], rtol=1e-3)
+    tr_sp, tr_d = snaps[True][2], snaps[False][2]
+    for name in tr_sp.params:
+        np.testing.assert_allclose(
+            np.asarray(tr_sp.params[name]), np.asarray(tr_d.params[name]),
+            rtol=1e-3, atol=1e-5, err_msg=name)
+
+
+def test_exchange_untouched_rows_and_adam_moments_bit_identical():
+    V = 40
+    FLAGS.set("sparse_grads", True)
+    tr = _ctr_trainer(vocab=V)
+    init = np.asarray(tr.params["_slot_emb.w"]).copy()
+    rng = np.random.RandomState(3)
+    used = np.arange(0, 10)                   # batch vocabulary: ids 0..9
+    for _ in range(3):
+        ids = jnp.asarray(rng.choice(used, size=(4, 6)).astype(np.int32))
+        tr.train_one_batch({
+            "ids": SequenceBatch(ids, jnp.asarray(np.full((4,), 6,
+                                                          np.int32))),
+            "label": jnp.asarray(rng.randint(0, 2, (4,))
+                                 .astype(np.int32))})
+    _assert_exchanging(tr)
+
+    table = np.asarray(tr.params["_slot_emb.w"])
+    unused = np.arange(10, V)
+    np.testing.assert_array_equal(table[unused], init[unused])
+    assert np.abs(table[used] - init[used]).max() > 0
+    # Adam moments of untouched rows: never written, still exactly the
+    # zero-init — the row-local apply never materializes a dense grad
+    leaf_names = tr._param_leaf_names()
+    slot = tr.opt_state[1][leaf_names.index("_slot_emb.w")]
+    moments = [np.asarray(m) for m in jax.tree_util.tree_leaves(slot)
+               if np.ndim(m) == 2 and np.shape(m)[0] == V]
+    assert len(moments) == 2                  # Adam: m and v
+    for m in moments:
+        np.testing.assert_array_equal(m[unused],
+                                      np.zeros_like(m[unused]))
+        assert np.abs(m[used]).max() > 0
+
+
+def test_sparse_grads_off_restores_legacy_program():
+    """--sparse_grads=false restores the legacy program, byte-for-byte.
+    Under SGD (no slots) the lazy-masked sparse path IS the dense
+    update on every row, so flag-off must match a never-eligible
+    (``sparse_update=False``) model exactly; under Adam the legacy
+    lazy semantics must survive — untouched rows and their moments
+    stay bit-identical (test_sparse.py pins the same contract for the
+    exchange path, so both flag positions implement one behavior)."""
+    V = 128
+    feeds = [_feed(np.random.RandomState(30 + i), V) for i in range(3)]
+    FLAGS.set("sparse_grads", False)
+
+    def build(sparse, method):
+        with config_scope():
+            ids = dsl.data("ids", integer_value_sequence(V))
+            lab = dsl.data("label", integer_value(2))
+            emb = dsl.embedding(
+                ids, size=8, param_attr=dsl.ParamAttr(
+                    name="_slot_emb.w", sparse_update=sparse,
+                    initial_std=0.1))
+            pooled = dsl.pooling(emb, pooling_type=dsl.SumPooling())
+            tower = dsl.fc(pooled, size=16, act=dsl.ReluActivation())
+            pred = dsl.fc(tower, size=2, act=dsl.SoftmaxActivation())
+            cfg = dsl.topology(dsl.classification_cost(pred, lab))
+        return Trainer(NeuralNetwork(cfg), opt_config=OptimizationConfig(
+            learning_method=method, learning_rate=1e-2), seed=0)
+
+    tr_off = build(True, "sgd")                    # eligible, flag off
+    loss_off = _run(tr_off, feeds)
+    assert tr_off._sparse_exchange_plan() == {}
+    tr_never = build(False, "sgd")                 # never eligible
+    loss_never = _run(tr_never, feeds)
+    assert loss_off == loss_never
+    assert _bytes(tr_off.params) == _bytes(tr_never.params)
+    assert _bytes(tr_off.opt_state) == _bytes(tr_never.opt_state)
+
+    tr_adam = build(True, "adam")
+    init = np.asarray(tr_adam.params["_slot_emb.w"]).copy()
+    small = [_feed(np.random.RandomState(40 + i), 10) for i in range(3)]
+    for f in small:                                # ids 0..9 only
+        tr_adam.train_one_batch(dict(f))
+    table = np.asarray(tr_adam.params["_slot_emb.w"])
+    np.testing.assert_array_equal(table[10:], init[10:])
+    assert np.abs(table[:10] - init[:10]).max() > 0
+
+
+# ===================================================== gather kill switch
+def _dispatch_delta(fn):
+    c = REGISTRY.counter("embedding_dispatch_total")
+    before = {(s["labels"].get("path"), s["labels"].get("reason")):
+              s["value"] for s in c.samples()}
+    out = fn()
+    after = {(s["labels"].get("path"), s["labels"].get("reason")):
+             s["value"] for s in c.samples()}
+    return out, {k: v - before.get(k, 0.0)
+                 for k, v in after.items() if v != before.get(k, 0.0)}
+
+
+def test_gather_rows_kernel_kill_switch_byte_identical():
+    """Interpret-mode Pallas kernel vs --embedding_kernel=false dense
+    gather: byte-equal rows, correct dispatch labels, both directions.
+    Off-TPU with the interpret opt-in unset, the dispatch declines the
+    kernel with reason ``no_tpu`` (it would run seconds per call)."""
+    from paddle_tpu.ops import pallas_embedding as pemb
+
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(96, 128).astype(np.float32))
+    rows = jnp.asarray([0, 5, 95, 5, -1, 96], jnp.int32)   # dups + pads
+
+    FLAGS.set("embedding_kernel", True)
+    FLAGS.set("embedding_kernel_interpret", True)
+    kern, d_kern = _dispatch_delta(
+        lambda: np.asarray(pemb.gather_rows(table, rows)))
+    assert d_kern == {("kernel", ""): 1.0}
+
+    FLAGS.set("embedding_kernel", False)
+    dense, d_off = _dispatch_delta(
+        lambda: np.asarray(pemb.gather_rows(table, rows)))
+    assert d_off == {("dense", "flag_off"): 1.0}
+
+    assert np.array_equal(kern, dense)
+    ref = np.asarray(pemb.gather_rows_reference(table, rows))
+    assert np.array_equal(kern, ref)
+    # pads clamp to a real row (callers drop the values)
+    assert np.array_equal(kern[4], np.asarray(table)[0])
+    assert np.array_equal(kern[5], np.asarray(table)[95])
+
+    FLAGS.set("embedding_kernel", True)
+    FLAGS.set("embedding_kernel_interpret", False)
+    no_tpu, d_cpu = _dispatch_delta(
+        lambda: np.asarray(pemb.gather_rows(table, rows)))
+    assert d_cpu == {("dense", "no_tpu"): 1.0}
+    assert np.array_equal(no_tpu, ref)
+
+
+# ================================================== row-sharded at scale
+def _hbm_categories(tr, feed):
+    import paddle_tpu.observe.memory as omem
+    tr.train_one_batch(dict(feed))
+    cats = omem.account(tr)["categories"]
+    return cats["params"], cats["opt_state"]
+
+
+def test_row_sharded_table_per_chip_hbm_multiple():
+    """zoo_fsdp_rules('ctr') on the 8-device mesh: per-chip params AND
+    opt-state bytes ≥6× below replicated — the table dominates, and
+    only its 1/8 row slice lives on each chip."""
+    V, D = 100_000, 16
+    mesh = build_mesh({"data": 8}, jax.devices()[:8])
+    set_mesh(mesh)
+    feed = _feed(np.random.RandomState(5), V)
+
+    tr_sh = _ctr_trainer(vocab=V, emb_dim=D, mesh=mesh, fsdp=True)
+    p_sh, o_sh = _hbm_categories(tr_sh, feed)
+    spec = tr_sh.params["_slot_emb.w"].sharding.spec
+    assert any(ax is not None for ax in spec), spec
+
+    tr_rep = _ctr_trainer(vocab=V, emb_dim=D, mesh=mesh, fsdp=False)
+    p_rep, o_rep = _hbm_categories(tr_rep, feed)
+
+    assert p_rep >= 6 * p_sh, (p_rep, p_sh)
+    assert o_rep >= 6 * o_sh, (o_rep, o_sh)
+
+
+def test_sharded_ckpt_roundtrip_row_sharded_table(tmp_path):
+    V = 8192
+    mesh = build_mesh({"data": 8}, jax.devices()[:8])
+    set_mesh(mesh)
+    feed = _feed(np.random.RandomState(6), V)
+    tr = _ctr_trainer(vocab=V, emb_dim=16, mesh=mesh, fsdp=True)
+    for _ in range(2):
+        tr.train_one_batch(dict(feed))
+    _assert_exchanging(tr)
+    ckpt = tr.save(str(tmp_path / "ckpt"), 0)
+
+    man = load_manifest(ckpt)
+    assert man["format"] >= 2
+    table = man["shards"]["params"]["_slot_emb.w"]
+    assert table["shards"] == 8                 # row-sharded on disk
+    shard_files = [n for n in os.listdir(ckpt) if ".shard-" in n]
+    for n in shard_files:
+        assert n in man["files"], n
+    assert verify_checkpoint(ckpt)
+
+    tr2 = _ctr_trainer(vocab=V, emb_dim=16, mesh=mesh, fsdp=True, seed=7)
+    tr2.train_one_batch(dict(feed))
+    tr2.load(ckpt)
+    for name in tr.params:
+        assert np.array_equal(np.asarray(tr.params[name]),
+                              np.asarray(tr2.params[name])), name
+    assert np.isfinite(float(tr2.train_one_batch(dict(feed))))
+
+
+@pytest.mark.slow
+def test_ten_million_row_table_trains_sharded():
+    """The ISSUE's scale criterion: a 10^7-row table (320 MB fp32 +
+    640 MB Adam slots) trains on the 8-device mesh with ~1/8 per chip;
+    the exchange moves KBs of touched rows where the dense gradient
+    would be another 320 MB per step."""
+    V, D = 10_000_000, 8
+    mesh = build_mesh({"data": 8}, jax.devices()[:8])
+    set_mesh(mesh)
+    FLAGS.set("sparse_grads", True)
+    tr = _ctr_trainer(vocab=V, emb_dim=D, mesh=mesh, fsdp=True)
+    feed = _feed(np.random.RandomState(8), V, batch=8, seq_len=4)
+    assert np.isfinite(float(tr.train_one_batch(dict(feed))))
+    _assert_exchanging(tr)
+    import paddle_tpu.observe.memory as omem
+    cats = omem.account(tr)["categories"]
+    table_bytes = V * D * 4
+    assert cats["params"] < table_bytes / 6 + 2 * 10**6
+    assert cats["opt_state"] < 2 * table_bytes / 6 + 4 * 10**6

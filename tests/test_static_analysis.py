@@ -179,8 +179,9 @@ def test_shape_fixture_catches_every_mismatch_class():
     res = _run_one("shape_violation.py", rules=["PT-SHAPE"])
     assert all(f.rule == "PT-SHAPE" for f in res.findings)
     # wrong conv num_channels, class-count mismatch, float label,
-    # embedding over dense, addto width disagreement — one each
-    assert _lines(res, "PT-SHAPE") == [11, 20, 27, 32, 38]
+    # embedding over dense, addto width disagreement, embedding table
+    # smaller than its declared id space — one each
+    assert _lines(res, "PT-SHAPE") == [11, 20, 27, 32, 38, 43]
     by_line = {f.line: f.message for f in res.findings}
     assert "wrong num_channels" in by_line[11]
     assert "10 class probabilities" in by_line[20] \
@@ -188,6 +189,7 @@ def test_shape_fixture_catches_every_mismatch_class():
     assert "integer class-id label" in by_line[27]
     assert "embedding lookup over a non-integer input" in by_line[32]
     assert "addto inputs disagree" in by_line[38]
+    assert "1000 rows" in by_line[43] and "5000-value range" in by_line[43]
     # full layer-path provenance rides along on graph findings
     assert "[layer path:" in by_line[20]
 
