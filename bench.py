@@ -851,10 +851,7 @@ def bench_serving():
     The observatory stamp is trainer-free: region attribution via
     ``costmodel.analyze_fn`` on the jitted decode step, HBM census via
     ``observe.memory.sample`` over the live params + KV pools."""
-    import types as _types
-
-    from paddle_tpu.serving.model import (DecoderModel, _decode_impl,
-                                          init_decoder_params)
+    from paddle_tpu.serving.model import DecoderModel, init_decoder_params
 
     cfg, n_req, (lo, hi), max_new, max_batch, pool_pages, page, passes \
         = _serving_shapes()
@@ -899,8 +896,22 @@ def bench_serving():
         r["slo_ms"] = slo_ms
         r["slo_met"] = bool(cont["p99_ms"] <= slo_ms)
 
-    # ---- trainer-free observatory stamp: attribute ONE decode step at
-    # the serving batch width (the loop's steady-state program)
+    return _decoder_observatory_stamp(r, model, cfg, max_batch,
+                                      pool_pages, page,
+                                      cache_key="serving-decode")
+
+
+def _decoder_observatory_stamp(r, model, cfg, max_batch, pool_pages,
+                               page, cache_key):
+    """Trainer-free observatory stamp shared by the serving and rollout
+    lanes: attribute ONE jitted decode step at the lane's batch width
+    (the loop's steady-state program) via ``costmodel.analyze_fn``,
+    HBM census via ``observe.memory.sample`` over the live params + KV
+    pools, and the decode step's own MFU."""
+    import types as _types
+
+    from paddle_tpu.serving.model import _decode_impl
+
     k_pool, v_pool = model.new_pools(pool_pages, page)
     max_pages = min(pool_pages - 1,
                     (cfg.max_context + page - 1) // page)
@@ -916,7 +927,7 @@ def bench_serving():
             return _decode_impl(p, kp, vp, tk, pi, ln, ac, cfg)
 
     report = costmodel.analyze_fn(_step, sargs, known=["decode_step"],
-                                  cache_key="serving-decode")
+                                  cache_key=cache_key)
     if report is not None:
         r["hbm_gb_per_step"] = round(report["xla_bytes"] / 1e9, 2) \
             if report["xla_bytes"] else None
@@ -959,6 +970,200 @@ def bench_serving():
     r["flops_per_step"] = round(flops, 1)
     r["decode_step_ms"] = round(step_s * 1e3, 3)
     return r
+
+
+# --rollout_small: CPU-runnable shapes for the hot-swap lane
+ROLLOUT_SMALL = False
+
+
+def _rollout_shapes():
+    """(cfg, n_requests, prompt_len_range, max_new, max_batch,
+    pool_pages, page_size, timed_passes) for the rollout lane — the
+    serving-lane decoder tiers (the swap A/B needs two int8 exports
+    of it).  eos_id=-1 (unreachable for argmax) so BOTH checkpoints
+    generate exactly max_new tokens per request — the two windows
+    compare identical token volume, not two models' different greedy
+    stopping points."""
+    from paddle_tpu.serving.model import DecoderConfig
+
+    if ROLLOUT_SMALL:
+        # 3 timed pass-pairs, not 2: continuous batching admits by
+        # thread timing, so a pass can randomly form a packed-prefill
+        # bucket the warmup never compiled — one XLA cold compile in a
+        # window is a 10x outlier on CPU, and the median over 3 ratios
+        # shrugs it off where a mean over 2 cannot.
+        return (DecoderConfig(vocab=512, dim=64, heads=4, layers=2,
+                              ffn=128, max_context=128, eos_id=-1),
+                12, (4, 24), 8, 4, 64, 16, 3)
+    return (DecoderConfig(vocab=4000, dim=256, heads=8, layers=4,
+                          ffn=1024, max_context=512, eos_id=-1),
+            48, (16, 96), 32, 8, 512, 16, 3)
+
+
+def _rollout_pass(srv, prompts, max_new, swap_art=None):
+    """One open-loop pass over the request stream; with ``swap_art``
+    a real hot-swap (build + verify + probe + flip) lands inside the
+    measurement window, after submission while the batch decodes.
+    Returns (wall_s, ttft list, swap report or None, failed count)."""
+    from paddle_tpu.serving import rollout as ro
+
+    t0 = time.perf_counter()
+    reqs = [srv.submit(p, max_new) for p in prompts]
+    rep = None
+    if swap_art is not None:
+        rep = ro.swap_from_artifact(srv, swap_art)
+        if rep["result"] != "ok":
+            raise RuntimeError(f"hot-swap failed mid-bench: {rep}")
+    failed, ttfts = 0, []
+    for r in reqs:
+        try:
+            srv.result(r, timeout=600.0)
+            ttfts.append(r.ttft_s)
+        except Exception:       # noqa: BLE001 — counted, asserted zero
+            failed += 1
+    return time.perf_counter() - t0, ttfts, rep, failed
+
+
+def bench_rollout():
+    """Rollout lane (`--only rollout`, round 23): sustained req/s and
+    TTFT p99 of the continuous-batching server while a zero-downtime
+    hot-swap lands inside the measurement window, vs the same request
+    stream at steady state.  Each timed swap window swaps to a
+    genuinely DIFFERENT artifact (two int8 exports of the serving
+    decoder, alternated), so every window pays a full off-thread
+    build + digest verify + probe plus the decode-boundary pointer
+    flip.
+
+    Headline: swap-window TTFT p99 over steady TTFT p99 (lower is
+    better, 1.0 = swaps are free).  The gate also bands the per-mode
+    ``req_per_sec`` / ``p99_ms`` rows; the zero-downtime contract —
+    every request in every window completes — is asserted outright
+    (``failed_requests`` stays informational at 0), and the swap
+    report's ``pause_s`` (the only moment the decode loop is not
+    decoding) rides along in ms."""
+    import os
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving.loader import artifact_digest, read_manifest
+    from paddle_tpu.serving.model import (DecoderModel, export_decoder,
+                                          init_decoder_params)
+    from paddle_tpu.serving.server import InferenceServer
+
+    cfg, n_req, (lo, hi), max_new, max_batch, pool_pages, page, passes \
+        = _rollout_shapes()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, cfg.vocab,
+                           rng.randint(lo, hi + 1)).tolist()
+               for _ in range(n_req)]
+    tmp = tempfile.mkdtemp(prefix="bench-rollout-")
+    try:
+        arts = []
+        for seed in (0, 1):
+            d = os.path.join(tmp, f"art-{seed}")
+            export_decoder(
+                {k: np.asarray(v) for k, v in
+                 init_decoder_params(cfg, seed=seed).items()},
+                cfg, d, quantize="int8")
+            arts.append(d)
+        mdl = DecoderModel.from_artifact(arts[0])
+        srv = InferenceServer(
+            mdl, max_batch=max_batch,
+            n_pages=pool_pages, page_size=page, continuous=True,
+            model_version=artifact_digest(
+                read_manifest(arts[0]))).start()
+        try:
+            # deterministically compile EVERY packed-prefill bucket the
+            # admission loop can form — (b, ceil(T/16)*16) for
+            # b <= max_batch, T <= the longest prompt.  Continuous
+            # batching admits by thread timing, so which buckets a
+            # pass forms is luck; an uncompiled one landing in a timed
+            # window is a multi-second XLA cold compile — a 10x
+            # outlier that has nothing to do with the swap under test.
+            # Both artifacts share the config, so the shared
+            # _jitted_steps cache makes one compile cover both models.
+            mp = min(pool_pages - 1, (cfg.max_context + page - 1) // page)
+            kp, vp = mdl.new_pools(pool_pages, page)
+            t_hi = min(-(-hi // 16) * 16, cfg.max_context)
+            for b in range(1, max_batch + 1):
+                for t in range(16, t_hi + 1, 16):
+                    mdl.prefill(kp, vp,
+                                np.ones((b, t), np.int32),
+                                np.full((b,), t, np.int32),
+                                np.ones((b, mp), np.int32))
+            del kp, vp
+            # untimed warmup: a full swap cycle — the probe's bucket
+            # plus the admission patterns a drain window produces (a
+            # paused-then-resumed queue admits in groupings steady
+            # state never forms)
+            _rollout_pass(srv, prompts, max_new)
+            _rollout_pass(srv, prompts, max_new, swap_art=arts[1])
+            _rollout_pass(srv, prompts, max_new, swap_art=arts[0])
+            current = 0
+            steady_w, steady_t = [], []
+            swap_w, swap_t, reports = [], [], []
+            degr, failed = [], 0
+            for _ in range(passes):
+                w, t, _, f = _rollout_pass(srv, prompts, max_new)
+                steady_w.append(w)
+                steady_t.append(t)
+                failed += f
+                current = 1 - current
+                w, t, rep, f = _rollout_pass(srv, prompts, max_new,
+                                             swap_art=arts[current])
+                swap_w.append(w)
+                swap_t.append(t)
+                reports.append(rep)
+                failed += f
+                degr.append(
+                    float(np.percentile(swap_t[-1], 99))
+                    / max(float(np.percentile(steady_t[-1], 99)),
+                          1e-9))
+        finally:
+            srv.stop()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if failed:
+        raise RuntimeError(
+            f"zero-downtime contract violated: {failed} request(s) "
+            "failed during the rollout lane")
+
+    def _mode(walls, ttfts):
+        flat = [x for t in ttfts for x in t]
+        return {
+            "req_per_sec": round(float(np.median(
+                [n_req / w for w in walls])), 3),
+            "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 3),
+            "p50_ttft_ms": round(
+                float(np.percentile(flat, 50)) * 1e3, 3),
+        }
+
+    r = _with_band({
+        "metric": "rollout_swap_p99_degradation",
+        "value": float(np.median(degr)),
+        "unit": "x steady TTFT p99 (swap in window; lower is better)",
+        "devices": 1,
+        "scale": "small" if ROLLOUT_SMALL else "bench",
+        "rows": [{"workload": "live_swap",
+                  "steady": _mode(steady_w, steady_t),
+                  "swap": _mode(swap_w, swap_t)}],
+        "failed_requests": failed,
+        "swaps": len(reports),
+        "inflight_policy": str(FLAGS.get("rollout_inflight")),
+        "swap_pause_ms_p50": round(float(np.median(
+            [r["pause_s"] for r in reports])) * 1e3, 3),
+        "swap_build_ms_p50": round(float(np.median(
+            [r["build_s"] for r in reports])) * 1e3, 3),
+        "swap_total_ms_p50": round(float(np.median(
+            [r["swap_s"] for r in reports])) * 1e3, 3),
+        "vs_baseline_note": "reference reloads by restarting the "
+                            "serving process; the in-place hot-swap "
+                            "is the yardstick-free rebuild surface",
+    }, values=degr)
+    r["perf_stamp_of"] = "decode_step"
+    return _decoder_observatory_stamp(
+        r, DecoderModel(init_decoder_params(cfg, seed=0), cfg), cfg,
+        max_batch, pool_pages, page, cache_key="rollout-decode")
 
 
 # --multichip_small: CPU-runnable shapes for the FSDP scaling lane
@@ -2069,7 +2274,7 @@ def main(argv=None):
 
     lanes = ["lstm", "resnet", "seq2seq", "attention", "lstm1280",
              "lstm2048", "pipeline", "precision", "observe", "serving",
-             "multichip", "sparse"]
+             "multichip", "sparse", "rollout"]
     ap = argparse.ArgumentParser()
     ap.add_argument("--only",
                     help="run a subset of lanes (comma-separated): "
@@ -2094,6 +2299,11 @@ def main(argv=None):
                          "lane with a CPU-sized decoder (the JSON line "
                          "records scale='small'); default is bench "
                          "scale")
+    ap.add_argument("--rollout_small", action="store_true",
+                    help="run the hot-swap rollout lane (steady vs "
+                         "swap-in-window req/s + TTFT p99) with a CPU-"
+                         "sized decoder (the JSON line records "
+                         "scale='small'); default is bench scale")
     ap.add_argument("--multichip_small", action="store_true",
                     help="run the FSDP weak/strong scaling lane at CPU-"
                          "runnable transformer shapes over the virtual-"
@@ -2179,6 +2389,9 @@ def main(argv=None):
     if args.serving_small:
         global SERVING_SMALL
         SERVING_SMALL = True
+    if args.rollout_small:
+        global ROLLOUT_SMALL
+        ROLLOUT_SMALL = True
     if args.multichip_small:
         global MULTICHIP_SMALL
         MULTICHIP_SMALL = True
@@ -2216,7 +2429,8 @@ def main(argv=None):
                    "observe": bench_observe,
                    "serving": bench_serving,
                    "multichip": bench_multichip,
-                   "sparse": bench_sparse}
+                   "sparse": bench_sparse,
+                   "rollout": bench_rollout}
         order = [t.strip() for t in args.only.split(",") if t.strip()] \
             if args.only else lanes
         unknown = [t for t in order if t not in benches]
@@ -2244,7 +2458,8 @@ def main(argv=None):
                             or ATTENTION_SMALL
                             or SERVING_SMALL
                             or MULTICHIP_SMALL
-                            or SPARSE_SMALL else "bench"),
+                            or SPARSE_SMALL
+                            or ROLLOUT_SMALL else "bench"),
                   "argv": sys.argv[1:] if argv is None else list(argv)})
         print(f"wrote baseline {args.write_baseline} "
               f"({len(doc['series'])} series)", file=sys.stderr,

@@ -51,7 +51,7 @@ def _direction(metric: str, unit: str = "") -> str:
     if "ratio" in name or "bound" in name:
         return "abs"
     for needle in ("ms_per_batch", "ms_per_call", "_ms", "seconds",
-                   "overhead", "latency"):
+                   "overhead", "latency", "degradation"):
         if needle in name:
             return "lower"
     for needle in ("per_sec", "speedup", "samples", "tokens", "mfu",
@@ -87,13 +87,15 @@ def series_from_line(line: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
     # Modes: pipeline sync/prefetch, precision fp32/bf16, attention
     # dense/legacy/block-skip + padded/packed + paged decode, serving
     # continuous/sequential, multichip fsdp/replicated, embedding
-    # sparse (lookup kernel + sparse-exchange training, dense A/B).
+    # sparse (lookup kernel + sparse-exchange training, dense A/B),
+    # rollout steady/swap (req/s + p99 with a hot-swap in the window).
     for row in line.get("rows", ()):
         tag = row.get("workload", "?")
         for mode in ("sync", "prefetch", "fp32", "bf16", "dense",
                      "legacy", "block_skip", "padded", "packed",
                      "decode", "continuous", "sequential",
-                     "fsdp", "replicated", "sparse"):
+                     "fsdp", "replicated", "sparse",
+                     "steady", "swap"):
             sub = row.get(mode) or {}
             for key, unit, direction, suffix in (
                     ("ms_per_batch", "ms/batch", "lower", "_ms"),

@@ -125,6 +125,38 @@ def reset_identity() -> None:
     """Drop programmatic identity overrides (tests)."""
     with _identity_lock:
         _identity.clear()
+    with _serving_lock:
+        _serving_info.clear()
+
+
+# Serving-plane info a replica publishes alongside its identity: model
+# version (artifact digest + export time) and rollout state.  Rides
+# every frame as the optional "serving" field (additive — schema 1
+# aggregators that predate it simply ignore the key).
+_serving_lock = named_lock("observe.fleet.serving")
+_serving_info: Dict[str, Any] = {}
+
+
+def set_serving_info(version: Optional[str] = None,
+                     state: Optional[str] = None,
+                     exported_at: Optional[float] = None,
+                     error: Optional[str] = None) -> None:
+    """Publish this process's served-model version + rollout state
+    (``serving/server.py`` calls this at start and at every swap /
+    rollback); lands on the next pushed frame."""
+    with _serving_lock:
+        if version is not None:
+            _serving_info["model_version"] = str(version)
+        _serving_info["rollout_state"] = str(state or "serving")
+        _serving_info["exported_at"] = exported_at
+        _serving_info["swap_error"] = error
+
+
+def serving_info() -> Dict[str, Any]:
+    """This process's published serving info (``{}`` when it never
+    loaded a model — trainers and exporters push no serving field)."""
+    with _serving_lock:
+        return dict(_serving_info)
 
 
 def identity() -> Dict[str, str]:
@@ -244,6 +276,8 @@ class FleetState:
                 "spans_dropped": int(frame.get("spans_dropped") or 0)
                 + (0 if (prev is None or restarted)
                    else prev.get("spans_dropped", 0)),
+                "serving": frame.get("serving")
+                if isinstance(frame.get("serving"), dict) else {},
             }
             self._procs[name] = entry
             # a restart KEEPS the predecessor incarnation's spans (the
@@ -349,6 +383,19 @@ class FleetState:
                 # missing process keeps its last-known health here)
                 "health": str(e["health"].get("status", "?")),
             }
+            serving = e.get("serving") or {}
+            if serving:
+                # the rollout plane: artifact digest + export time +
+                # swap state, straight off the replica's frames — what
+                # the rolling coordinator and --watch version column read
+                procs[name]["model_version"] = serving.get(
+                    "model_version", "?")
+                procs[name]["rollout_state"] = serving.get(
+                    "rollout_state", "?")
+                procs[name]["model_exported_at"] = serving.get(
+                    "exported_at")
+                if serving.get("swap_error"):
+                    procs[name]["swap_error"] = serving["swap_error"]
         return {"schema": FLEET_SCHEMA, "pid": os.getpid(),
                 "procs": procs}
 
@@ -484,6 +531,8 @@ class FleetState:
                 "hbm_peak_bytes": self._snapshot_value(
                     metrics, "hbm_peak_bytes", agg="max"),
                 "health": str(e["health"].get("status", "?")),
+                "version": (e.get("serving") or {}).get("model_version"),
+                "rollout": (e.get("serving") or {}).get("rollout_state"),
             })
         return rows
 
@@ -780,6 +829,11 @@ class FleetPusher:
         }
         if dropped:
             frame["spans_dropped"] = dropped
+        serving = serving_info()
+        if serving:
+            # additive, optional: only processes that loaded a serving
+            # model carry it, and older aggregators ignore the key
+            frame["serving"] = serving
         return frame
 
     # ------------------------------------------------------------- push
@@ -965,9 +1019,20 @@ def render_watch(rollup_doc: Dict[str, Any],
                        sorted(rollup_doc.get("counts", {}).items())
                        if v))
     cols = ["proc", "role", "pid", "status", "step/s", "input_bound",
-            "hbm_peak", "health", "last_seen"]
+            "hbm_peak", "health", "version", "last_seen"]
     table: List[List[str]] = [cols]
     for r in rows:
+        version = r.get("version")
+        rollout = r.get("rollout")
+        # digest-prefix + swap state: "1a2b3c4d5e6f" while serving,
+        # "1a2b…(swapping)" mid-rollout — a rolling rollout is visible
+        # as the column changing row by row
+        if version is None:
+            vcell = "-"
+        else:
+            vcell = str(version)[:12]
+            if rollout and rollout != "serving":
+                vcell = f"{vcell[:6]}…({rollout})"
         table.append([
             str(r["proc"]), str(r["role"]), str(r["pid"]),
             str(r["status"]),
@@ -976,7 +1041,7 @@ def render_watch(rollup_doc: Dict[str, Any],
             "-" if r["input_bound"] is None
             else f"{r['input_bound']:.3f}",
             _fmt_bytes(r["hbm_peak_bytes"]),
-            str(r["health"]), f"{r['last_seen_s']:.1f}s",
+            str(r["health"]), vcell, f"{r['last_seen_s']:.1f}s",
         ])
     widths = [max(len(row[i]) for row in table)
               for i in range(len(cols))]
@@ -1013,6 +1078,8 @@ def watch_once(addr: str) -> str:
             # DIFFERENT columns: a missing process still shows its
             # last-known health
             "health": p.get("health", "?"),
+            "version": p.get("model_version"),
+            "rollout": p.get("rollout_state"),
         })
     # headline metrics come from the merged exposition
     summaries: List[str] = []

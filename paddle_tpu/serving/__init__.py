@@ -25,10 +25,14 @@ sustained req/s:
 - :mod:`paddle_tpu.serving.server` — the continuous-batching
   :class:`InferenceServer` (admission queue, fixed-width decode batch,
   sequential kill switch, HTTP front, per-request telemetry).
+- :mod:`paddle_tpu.serving.rollout` — the zero-downtime train→serve
+  pipeline (ISSUE 19): checkpoint watcher, atomic hot-swap with
+  rollback, fleet-supervised rolling rollout.
 """
 
 from .export import export_inference_fn, export_network  # noqa: F401
-from .loader import ServedModel  # noqa: F401
+from .loader import (ServedModel, TornArtifact,  # noqa: F401
+                     artifact_digest, verify_artifact)
 from .pagepool import (PagePool, PagePoolExhausted,  # noqa: F401
                        TornSnapshot)
 
@@ -41,6 +45,10 @@ _LAZY = {
     "DecoderConfig": "model", "DecoderModel": "model",
     "export_decoder": "model", "init_decoder_params": "model",
     "InferenceServer": "server", "Request": "server",
+    "SwapTicket": "server",
+    "CheckpointWatcher": "rollout", "RollingCoordinator": "rollout",
+    "swap_from_artifact": "rollout", "export_checkpoint": "rollout",
+    "latest_valid_artifact": "rollout", "sweep_export_dir": "rollout",
 }
 
 

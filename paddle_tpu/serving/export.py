@@ -29,6 +29,15 @@ serving compute dtype) once at load and prepends them on every call;
                 "dequant_dtype": "bfloat16",
                 "entries": [{name, shape, dtype, quantized, axis}...]}
 
+Every manifest additionally carries a ``files`` section (per-file
+SHA-256 + byte size, written LAST like the checkpoint manifest) and an
+``exported_at_unix`` stamp — ``loader.verify_artifact`` re-hashes the
+payload against it, which is what makes a truncated or bit-flipped
+artifact detectable before it ever reaches a live server
+(``serving/rollout.py``).  Manifests without a ``files`` section
+(pre-rollout artifacts) still load; they just cannot be
+digest-verified.
+
 Version-1 artifacts keep loading unchanged (``serving/loader.py``
 supports both).  The measurement template is the Gemma-on-TPU study
 (PAPERS.md, arxiv 2605.25645): ~4× smaller weight payload, with the
@@ -42,8 +51,10 @@ function, reentrant by construction.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -63,6 +74,37 @@ QUANT_FORMAT_VERSION = 2
 MODULE_FILE = "model.stablehlo"
 WEIGHTS_FILE = "weights.npz"
 QUANT_SCHEME = "int8-weights-per-channel"
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def artifact_file_digests(dirname: str, fnames: Sequence[str]
+                          ) -> Dict[str, Dict[str, Any]]:
+    """The manifest ``files`` section: per-file SHA-256 + size, same
+    shape as ``trainer/checkpoint.py``'s checkpoint manifest so the
+    rollout pipeline verifies artifacts and checkpoints identically.
+    The manifest itself is excluded (it is written last and carries
+    the digests)."""
+    return {fn: {"sha256": _sha256_file(os.path.join(dirname, fn)),
+                 "bytes": os.path.getsize(os.path.join(dirname, fn))}
+            for fn in fnames}
+
+
+def stamp_manifest(manifest: Dict[str, Any], dirname: str,
+                   fnames: Sequence[str]) -> Dict[str, Any]:
+    """Add the integrity + provenance fields every serving manifest
+    carries: per-file digests and the export wall-clock time.  Must be
+    called after every payload file is on disk, right before the
+    manifest write (the manifest is the commit record)."""
+    manifest["files"] = artifact_file_digests(dirname, fnames)
+    manifest["exported_at_unix"] = time.time()
+    return manifest
 
 
 def _feed_spec(name: str, arr: np.ndarray, poly_batch: bool) -> Dict[str, Any]:
@@ -215,6 +257,7 @@ def export_inference_fn(fn, example_feed: Dict[str, Any], dirname: str,
         "module": MODULE_FILE,
         "batch_polymorphic": poly,
     }
+    stamp_manifest(manifest, dirname, [MODULE_FILE])
     with open(os.path.join(dirname, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     return dirname
@@ -348,6 +391,7 @@ def _export_network_int8(fwd, params, flat_examples, dirname,
             "entries": entries,
         },
     }
+    stamp_manifest(manifest, dirname, [MODULE_FILE, WEIGHTS_FILE])
     with open(os.path.join(dirname, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     quant_bytes = sum(v.nbytes for k, v in store.items()

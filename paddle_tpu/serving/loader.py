@@ -21,6 +21,7 @@ call.  Version-1 artifacts load exactly as before.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
 from typing import Any, Dict, List
@@ -60,6 +61,61 @@ try:
     from ..observe import fleet as _fleet, trace as _trace
 except ImportError:  # standalone copy: no package context
     _counter = _gauge = _histogram = _trace = _fleet = None
+
+
+class TornArtifact(ValueError):
+    """An artifact whose payload does not match its manifest digests —
+    truncated, bit-flipped, or mid-write.  The rollout pipeline treats
+    this as "skip and keep serving the old model", never as fatal."""
+
+
+def verify_artifact(dirname: str, manifest: Dict[str, Any] = None) -> bool:
+    """Re-hash every payload file against the manifest ``files`` section.
+
+    Returns True when the digests all match, False when the manifest
+    predates digest stamping (nothing to verify against — pre-rollout
+    artifacts still load, they just cannot be proven whole).  Raises
+    :class:`TornArtifact` on a missing, short, long, or corrupt file.
+    """
+    if manifest is None:
+        manifest = read_manifest(dirname)
+    files = manifest.get("files")
+    if not files:
+        return False
+    for fn, meta in sorted(files.items()):
+        path = os.path.join(dirname, fn)
+        if not os.path.exists(path):
+            raise TornArtifact(f"{dirname}: missing payload file {fn!r}")
+        size = os.path.getsize(path)
+        if size != meta["bytes"]:
+            raise TornArtifact(
+                f"{dirname}: {fn} is {size} bytes, manifest says "
+                f"{meta['bytes']} (truncated or partially written)")
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != meta["sha256"]:
+            raise TornArtifact(f"{dirname}: {fn} sha256 mismatch "
+                               f"(expected {meta['sha256'][:12]}…, got "
+                               f"{h.hexdigest()[:12]}…)")
+    return True
+
+
+def artifact_digest(manifest: Dict[str, Any]) -> str:
+    """Content-stable version id of an artifact: sha256 over the sorted
+    per-file digests.  Two exports of identical payload bytes get the
+    same id; any payload change changes it.  This is the
+    ``model_version`` the server, fleet topology, and rollout
+    coordinator all speak."""
+    files = manifest.get("files")
+    if not files:
+        return "unversioned"
+    h = hashlib.sha256()
+    for fn in sorted(files):
+        h.update(fn.encode())
+        h.update(files[fn]["sha256"].encode())
+    return h.hexdigest()
 
 
 def read_manifest(dirname: str, max_version: int = 2) -> Dict[str, Any]:
@@ -114,12 +170,16 @@ class ServedModel:
         self.fetch_names = list(manifest["fetches"])
 
     @classmethod
-    def load(cls, dirname: str) -> "ServedModel":
+    def load(cls, dirname: str, verify: bool = True) -> "ServedModel":
         if _fleet is not None:
             # a process loading a serving artifact pushes (when
             # --fleet_addr is set) as role=serving; a dict write, free
             _fleet.set_identity(role="serving")
         manifest = read_manifest(dirname)
+        if verify:
+            # raises TornArtifact on digest mismatch; manifests without
+            # a files section (pre-rollout exports) load unverified
+            verify_artifact(dirname, manifest)
         if manifest.get("kind") == "decoder":
             raise ValueError(
                 f"{dirname}: decoder artifact — load it with "

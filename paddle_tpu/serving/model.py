@@ -31,6 +31,7 @@ loads it through the shared ``loader.read_manifest`` /
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 from typing import Any, Dict, NamedTuple, Optional, Tuple
@@ -173,6 +174,29 @@ def _decode_impl(params, k_pool, v_pool, tokens, page_indices, lengths,
     return nxt.astype(jnp.int32), logits, k_pool, v_pool
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_steps(cfg: DecoderConfig):
+    """One jitted (prefill, decode) pair PER CONFIG, shared by every
+    :class:`DecoderModel` of that config.  Params are traced arguments
+    (not closure constants), so two models with the same config hit
+    the same executables — which is what makes a hot-swap
+    (``serving/rollout.py``) actually zero-downtime: the swapped-in
+    model rides every (B, T) bucket the serving process has already
+    compiled instead of stalling the first post-flip requests behind
+    a full recompile."""
+    # static cfg via closure; jax caches one executable per
+    # (B, T)/(B,) shape bucket.  No buffer donation: CPU (the test
+    # platform) does not alias donations and warns per compile —
+    # on TPU the pools would be donate_argnums=(1, 2)
+    prefill = jax.jit(
+        lambda p, kp, vp, tk, ln, pi: _prefill_impl(
+            p, kp, vp, tk, ln, pi, cfg))
+    decode = jax.jit(
+        lambda p, kp, vp, tk, pi, ln, ac: _decode_impl(
+            p, kp, vp, tk, pi, ln, ac, cfg))
+    return prefill, decode
+
+
 class DecoderModel:
     """A loaded decoder + its jitted prefill/decode steps.
 
@@ -187,16 +211,7 @@ class DecoderModel:
         # fp32 on-device once; dequantized int8 artifacts land here too
         self.params = {k: jax.device_put(np.asarray(v))
                        for k, v in params.items()}
-        # static cfg via closure; jax caches one executable per
-        # (B, T)/(B,) shape bucket.  No buffer donation: CPU (the test
-        # platform) does not alias donations and warns per compile —
-        # on TPU the pools would be donate_argnums=(1, 2)
-        self._prefill = jax.jit(
-            lambda p, kp, vp, tk, ln, pi: _prefill_impl(
-                p, kp, vp, tk, ln, pi, cfg))
-        self._decode = jax.jit(
-            lambda p, kp, vp, tk, pi, ln, ac: _decode_impl(
-                p, kp, vp, tk, pi, ln, ac, cfg))
+        self._prefill, self._decode = _jitted_steps(cfg)
 
     # ----------------------------------------------------------- pools
     def new_pools(self, n_pages: int, page_size: int
@@ -234,10 +249,16 @@ class DecoderModel:
 
     # -------------------------------------------------------- artifacts
     @classmethod
-    def from_artifact(cls, dirname: str) -> "DecoderModel":
+    def from_artifact(cls, dirname: str, verify: bool = True
+                      ) -> "DecoderModel":
         """Load an exported decoder artifact (int8 entries dequantized
-        once at load through the shared loader path)."""
+        once at load through the shared loader path).  ``verify``
+        re-hashes the payload against the manifest digests first —
+        a torn artifact raises :class:`loader.TornArtifact` before any
+        weight byte is interpreted."""
         manifest = _loader.read_manifest(dirname)
+        if verify:
+            _loader.verify_artifact(dirname, manifest)
         enforce(manifest.get("kind") == "decoder",
                 f"{dirname}: not a decoder artifact "
                 f"(kind={manifest.get('kind')!r}); ServedModel.load "
@@ -252,12 +273,18 @@ class DecoderModel:
 
 def export_decoder(params: Dict[str, Any], cfg: DecoderConfig,
                    dirname: str, quantize: Optional[str] = "int8",
-                   dequant_dtype: str = "float32") -> str:
+                   dequant_dtype: str = "float32",
+                   extra_meta: Optional[Dict[str, Any]] = None) -> str:
     """Write a decoder artifact: the version-2 weights layout of
     ``serving/export.py`` (int8 per-channel for ≥2-D floats when
     ``quantize="int8"``, raw otherwise) plus ``"kind": "decoder"`` and
     the :class:`DecoderConfig` in the manifest.  No StableHLO module —
-    the paged decode loop is live code, not an exported graph."""
+    the paged decode loop is live code, not an exported graph.
+
+    ``extra_meta`` lands verbatim in the manifest — the rollout
+    pipeline records provenance there (``source_ckpt_digest``,
+    ``source_ckpt``) so exactly-once export survives watcher restarts
+    without any side-channel state file."""
     if quantize is None:
         store = {}
         entries = []
@@ -287,6 +314,13 @@ def export_decoder(params: Dict[str, Any], cfg: DecoderConfig,
             "entries": entries,
         },
     }
+    if extra_meta:
+        for k, v in extra_meta.items():
+            enforce(k not in manifest,
+                    f"export_decoder: extra_meta key {k!r} collides with "
+                    "a manifest field")
+            manifest[k] = v
+    _export.stamp_manifest(manifest, dirname, [_export.WEIGHTS_FILE])
     with open(os.path.join(dirname, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
     return dirname

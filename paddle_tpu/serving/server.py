@@ -70,9 +70,10 @@ from .pagepool import PagePool, PagePoolExhausted, SCRATCH_PAGE, TornSnapshot
 try:                         # telemetry optional, as in loader.py
     from ..observe import counter as _counter, gauge as _gauge
     from ..observe import histogram as _histogram, trace as _trace
+    from ..observe import fleet as _fleet
     from ..observe.http import make_threading_server, resolve_bind_host
 except ImportError:  # pragma: no cover - standalone copy
-    _counter = _gauge = _histogram = _trace = None
+    _counter = _gauge = _histogram = _trace = _fleet = None
     make_threading_server = resolve_bind_host = None
 
 log = get_logger("serving")
@@ -132,17 +133,58 @@ class Request:
         return None if self.t_done is None else self.t_done - self.t_submit
 
 
+class SwapTicket:
+    """A pending hot-swap: the fully built replacement model plus the
+    handshake back to the requester.  ``event`` fires once the decode
+    loop has applied (or rolled back) the swap; ``report`` then holds
+    the outcome — ``result`` (``ok``/``rolled_back``), the pointer-flip
+    ``pause_s``, and which in-flight requests were re-prefilled."""
+
+    __slots__ = ("model", "version", "inflight", "exported_at",
+                 "event", "report")
+
+    def __init__(self, model: DecoderModel, version: str, inflight: str,
+                 exported_at: Optional[float]):
+        self.model = model
+        self.version = version
+        self.inflight = inflight
+        self.exported_at = exported_at
+        self.event = threading.Event()
+        self.report: Dict = {"result": "pending", "version": version,
+                             "inflight": inflight}
+
+    def wait(self, timeout: Optional[float] = None) -> Dict:
+        if not self.event.wait(timeout):
+            raise TimeoutError(f"swap to {self.version[:12]} not applied "
+                               f"within {timeout}s")
+        return dict(self.report)
+
+
 class InferenceServer:
     """The continuous-batching decode loop around a
     :class:`~paddle_tpu.serving.model.DecoderModel` and a
-    :class:`~paddle_tpu.serving.pagepool.PagePool`."""
+    :class:`~paddle_tpu.serving.pagepool.PagePool`.
+
+    With ``--rollout`` (default on) the server also speaks the
+    zero-downtime train→serve protocol (``serving/rollout.py``):
+    :meth:`request_swap` parks a fully built replacement model as a
+    :class:`SwapTicket`; the decode loop applies it at a step boundary
+    — ``drain`` finishes in-flight requests on the OLD model first
+    (admissions pause), ``reprefill`` flips immediately and restarts
+    in-flight generation from the prompt on the NEW model — so every
+    response's tokens come from exactly one model.  ``--rollout=false``
+    is the kill switch: no swap surface, ``/healthz`` and the 404 body
+    byte-identical to the pre-rollout server."""
 
     def __init__(self, model: DecoderModel,
                  max_batch: Optional[int] = None,
                  n_pages: Optional[int] = None,
                  page_size: Optional[int] = None,
                  continuous: Optional[bool] = None,
-                 snapshot_path: Optional[str] = None):
+                 snapshot_path: Optional[str] = None,
+                 rollout: Optional[bool] = None,
+                 model_version: str = "unversioned",
+                 model_exported_at: Optional[float] = None):
         self.model = model
         self.max_batch = int(FLAGS.get("serve_max_batch")
                              if max_batch is None else max_batch)
@@ -170,6 +212,13 @@ class InferenceServer:
         self._http_thread: Optional[threading.Thread] = None
         self.served = 0
         self.generated_tokens = 0
+        self.rollout_enabled = bool(FLAGS.get("rollout")
+                                    if rollout is None else rollout)
+        self.model_version = model_version
+        self.model_exported_at = model_exported_at
+        self.rollout_state = "serving"     # serving|swapping|rolled_back
+        self.last_swap_error: Optional[str] = None
+        self._pending_swap: Optional[SwapTicket] = None
 
     @staticmethod
     def _make_pool(n_pages: int, page_size: int,
@@ -207,6 +256,7 @@ class InferenceServer:
             self._thread = threading.Thread(
                 target=self._loop, name=DECODE_THREAD_NAME, daemon=True)
             self._thread.start()
+            self._publish_serving_info()
         return self
 
     def stop(self) -> None:
@@ -222,11 +272,16 @@ class InferenceServer:
             pending = list(self._queue) + list(self._active)
             self._queue.clear()
             self._active = []
+            swap, self._pending_swap = self._pending_swap, None
         for r in pending:
             self.pool.release(r.id)
             r.state = "failed"
             r.error = "server stopped"
             r.done.set()
+        if swap is not None:       # a parked swap never applies now
+            swap.report.update(result="rolled_back",
+                               error="server stopped")
+            swap.event.set()
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
@@ -279,29 +334,182 @@ class InferenceServer:
     def stats(self) -> Dict[str, int]:
         with self._cond:
             q, a = len(self._queue), len(self._active)
-        return {"queue_depth": q, "active": a,
-                "free_pages": self.pool.free_pages(),
-                "used_pages": self.pool.used_pages(),
-                "served": self.served,
-                "generated_tokens": self.generated_tokens,
-                "continuous": int(self.continuous),
-                "max_batch": self.max_batch}
+            rollout = None
+            if self.rollout_enabled:
+                rollout = {"model_version": self.model_version,
+                           "model_exported_at": self.model_exported_at,
+                           "rollout_state": self.rollout_state,
+                           "last_swap_error": self.last_swap_error}
+        out = {"queue_depth": q, "active": a,
+               "free_pages": self.pool.free_pages(),
+               "used_pages": self.pool.used_pages(),
+               "served": self.served,
+               "generated_tokens": self.generated_tokens,
+               "continuous": int(self.continuous),
+               "max_batch": self.max_batch}
+        if rollout is not None:
+            # gated on the kill switch so --rollout=false keeps stats()
+            # (and with it the /healthz body) byte-identical to the
+            # pre-rollout server
+            out.update(rollout)
+        return out
+
+    # ------------------------------------------------------------ hot swap
+    def request_swap(self, model: DecoderModel,
+                     version: str = "unversioned",
+                     inflight: Optional[str] = None,
+                     exported_at: Optional[float] = None) -> SwapTicket:
+        """Park a fully built replacement model for the decode loop to
+        apply at its next step boundary; returns the
+        :class:`SwapTicket` to ``wait()`` on.  The model must already
+        be built, verified, and probed — this method does NO loading
+        (``rollout.swap_from_artifact`` is the full pipeline)."""
+        enforce(self.rollout_enabled,
+                "rollout disabled (--rollout=false): request_swap refused")
+        inflight = str(FLAGS.get("rollout_inflight")
+                       if inflight is None else inflight)
+        enforce(inflight in ("drain", "reprefill"),
+                f"unknown in-flight policy {inflight!r} "
+                "(expected 'drain' or 'reprefill')")
+        # same architecture is the contract (continuous training swaps
+        # weights, not shapes): pools, page tables, and every compiled
+        # shape bucket carry over only because the config is identical
+        enforce(model.cfg == self.model.cfg,
+                f"swap model config {model.cfg} != serving config "
+                f"{self.model.cfg}")
+        ticket = SwapTicket(model, version, inflight, exported_at)
+        with self._cond:
+            enforce(not self._stop, "server is stopped")
+            enforce(self._pending_swap is None,
+                    "a swap is already in progress")
+            self._pending_swap = ticket
+            self.rollout_state = "swapping"
+            ticket.report["inflight_at_request"] = len(self._active)
+            self._cond.notify_all()
+        self._publish_serving_info()
+        return ticket
+
+    def record_swap_failure(self, reason: str) -> None:
+        """Record a swap that failed BEFORE a ticket was ever parked
+        (artifact verify/load/probe ran off-thread and rolled back).
+        The old model keeps serving; ``/healthz`` carries the reason."""
+        with self._cond:
+            self.rollout_state = "rolled_back"
+            self.last_swap_error = reason
+        self._publish_serving_info()
+
+    def _apply_swap_locked(self, ticket: SwapTicket) -> List[Request]:
+        """Apply a parked swap at the decode-loop boundary (``_cond``
+        held).  Returns the in-flight requests to re-prefill on the new
+        model (``reprefill`` policy; empty under ``drain``, which only
+        gets here with no actives).  Failure to stand up the new pools
+        rolls back — the old model/pools were never unhooked."""
+        t0 = time.perf_counter()
+        old_version = self.model_version
+        try:
+            k_pool, v_pool = ticket.model.new_pools(self.pool.n_pages,
+                                                    self.pool.page_size)
+        except Exception as e:  # noqa: BLE001 - rollback, keep serving
+            self._pending_swap = None
+            self.rollout_state = "rolled_back"
+            self.last_swap_error = f"pool standup: {type(e).__name__}: {e}"
+            ticket.report.update(result="rolled_back",
+                                 error=self.last_swap_error)
+            if _counter is not None:
+                _counter("rollout_swap_total",
+                         "hot-swap attempts by outcome").inc(
+                    result="rolled_back")
+            log.error("swap to %s rolled back (%s)", ticket.version[:12],
+                      self.last_swap_error)
+            ticket.event.set()
+            return []
+        reprefill: List[Request] = []
+        if ticket.inflight == "reprefill" and self._active:
+            # restart in-flight generation from the prompt on the NEW
+            # model: drop every old-model token (exactly-one-model
+            # semantics), keep the page tables — fresh pools mean the
+            # prompt K/V is rewritten by the re-prefill
+            for r in self._active:
+                r.tokens.clear()
+                r.length = 0
+                r.next_token = -1
+                r.t_first = None
+            reprefill = list(self._active)
+            ticket.report["reprefilled"] = [r.id for r in reprefill]
+        self.model = ticket.model
+        self._k_pool, self._v_pool = k_pool, v_pool
+        self.model_version = ticket.version
+        self.model_exported_at = ticket.exported_at
+        self.rollout_state = "serving"
+        self.last_swap_error = None
+        self._pending_swap = None
+        pause_s = time.perf_counter() - t0
+        ticket.report.update(result="ok", pause_s=pause_s)
+        if _counter is not None:
+            _counter("rollout_swap_total",
+                     "hot-swap attempts by outcome").inc(result="ok")
+            _histogram("rollout_swap_pause_seconds",
+                       "decode-loop pause for the atomic pointer flip "
+                       "(pool standup + in-flight bookkeeping; the "
+                       "model build/verify/probe ran off-thread)"
+                       ).observe(pause_s)
+            g = _gauge("rollout_model_version",
+                       "1 for the live artifact digest, 0 for retired "
+                       "ones (info gauge keyed by digest label)")
+            if old_version:
+                g.set(0.0, digest=old_version)
+            g.set(1.0, digest=ticket.version)
+        log.info("hot-swapped model %s -> %s (pause %.1f ms, %d "
+                 "re-prefilled)", old_version[:12], ticket.version[:12],
+                 pause_s * 1e3, len(reprefill))
+        ticket.event.set()
+        return reprefill
+
+    def _publish_serving_info(self) -> None:
+        """Push model version + rollout state into the fleet identity so
+        every frame this process pushes carries them (``/fleet/topology``
+        and the ``--watch`` version column)."""
+        if _fleet is None or not self.rollout_enabled:
+            return
+        _fleet.set_serving_info(version=self.model_version,
+                                state=self.rollout_state,
+                                exported_at=self.model_exported_at,
+                                error=self.last_swap_error)
 
     # ---------------------------------------------------------- decode loop
     def _loop(self) -> None:
         while True:
+            swapped = False
             with self._cond:
                 while not self._stop and not self._queue \
-                        and not self._active:
+                        and not self._active \
+                        and self._pending_swap is None:
                     self._cond.wait(0.05)
                 if self._stop:
                     return
-                admitted = self._admit_locked()
+                reprefill: List[Request] = []
+                pending = self._pending_swap
+                if pending is not None and (pending.inflight == "reprefill"
+                                            or not self._active):
+                    # the atomic pointer flip, at the step boundary.
+                    # drain policy only flips once the actives emptied;
+                    # reprefill flips now and restarts them below
+                    reprefill = self._apply_swap_locked(pending)
+                    pending = None
+                    swapped = True
+                # a pending drain swap pauses admission: new requests
+                # must first-run on the NEW model, and the flip waits
+                # for the actives to finish on the old one
+                admitted = [] if pending is not None \
+                    else self._admit_locked()
+            if swapped:
+                self._publish_serving_info()
             try:
-                changed = bool(admitted)
-                if admitted:
-                    with _span_prefill(n=len(admitted)):
-                        self._prefill(admitted)
+                batch = reprefill + admitted
+                changed = bool(batch)
+                if batch:
+                    with _span_prefill(n=len(batch)):
+                        self._prefill(batch)
                 if self._active:
                     with _span_decode_step(batch=len(self._active)):
                         self._decode_step()
@@ -485,11 +693,40 @@ def _make_handler(server: InferenceServer):
             if self.path.split("?", 1)[0].rstrip("/") == "/healthz":
                 self._send(200, dict(server.stats(), status="ok"))
             else:
+                # /v1/swap only exists with rollout on — the kill
+                # switch keeps this body byte-identical to pre-rollout
+                paths = ["/v1/generate", "/healthz"]
+                if server.rollout_enabled:
+                    paths.append("/v1/swap")
                 self._send(404, {"error": "unknown path",
-                                 "paths": ["/v1/generate", "/healthz"]})
+                                 "paths": paths})
+
+        def _do_swap(self) -> None:
+            """POST /v1/swap {"artifact": dir[, "inflight": policy]} —
+            the rolling coordinator's per-replica step.  Runs the full
+            off-thread pipeline (verify → load → probe → flip) and
+            returns the swap report; 500 carries a rolled-back report,
+            so the coordinator halts without guessing."""
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                from . import rollout as _rollout
+                report = _rollout.swap_from_artifact(
+                    server, body["artifact"],
+                    inflight=body.get("inflight"))
+                ok = report.get("result") in ("ok", "unchanged")
+                self._send(200 if ok else 500, report)
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001 - bad request must
+                self._send(400, {"error": str(e)})  # never kill serving
 
         def do_POST(self) -> None:  # noqa: N802 - stdlib API
-            if self.path.split("?", 1)[0].rstrip("/") != "/v1/generate":
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/v1/swap" and server.rollout_enabled:
+                self._do_swap()
+                return
+            if path != "/v1/generate":
                 self._send(404, {"error": "unknown path"})
                 return
             try:

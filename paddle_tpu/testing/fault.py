@@ -26,6 +26,18 @@ wall-clock or RNG draws — so chaos tests stay reproducible:
   real page-pool snapshots) serving an endless request stream, built
   to be SIGKILLed mid-decode so a restart from the same snapshot path
   must prove the allocator state was never torn.
+- :func:`corrupt_artifact` / :func:`resign_artifact_manifest` — damage
+  a serving artifact after its digests were recorded (torn weights, or
+  a manifest re-signed with a wrong digest) so the rollout pipeline's
+  verify gate is the thing under test, mirroring
+  :func:`corrupt_checkpoint`.
+- :class:`TrainerLoopProcess` / :class:`ExporterProcess` /
+  :class:`RolloutServeProcess` — the three stages of the zero-downtime
+  train→serve pipeline (ISSUE 19) as SIGKILL-able children: a trainer
+  saving real checkpoints in a loop, an exporter running the real
+  :class:`~paddle_tpu.serving.rollout.CheckpointWatcher`, and a
+  serving replica that hot-swaps every new artifact while serving an
+  endless request stream — the chaos gauntlet kills each mid-flight.
 
 Everything is loopback/local-fs only; no real network is ever touched.
 """
@@ -415,6 +427,371 @@ class ServeServerProcess:
         self.kill()
 
 
+# -------------------------------------------- rollout chaos processes
+# The three stages of the train→serve pipeline as real child processes
+# (real save_checkpoint, real CheckpointWatcher, real InferenceServer
+# hot-swap), each killable at any instant.  They share the line
+# protocol of the harnesses above: a READY line on startup, then one
+# progress line per unit of work, read by the parent with a deadline.
+def _child_env() -> dict:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+class _LineChild:
+    """Popen wrapper with a deadline-checked line reader; subclasses
+    dispatch the child's progress lines in :meth:`_dispatch`."""
+
+    proc: Optional[subprocess.Popen] = None
+
+    def _spawn(self, script: str, args: Iterable[str],
+               ready_timeout_s: float) -> None:
+        assert self.proc is None or self.proc.poll() is not None, \
+            "child process already running"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", script, *[str(a) for a in args]],
+            stdout=subprocess.PIPE, text=True, env=_child_env())
+        line = self.proc.stdout.readline()   # blocks until READY
+        assert line.startswith("READY"), \
+            f"{type(self).__name__} child failed to start: {line!r}"
+        self._on_ready(line.split())
+
+    def _on_ready(self, fields: list) -> None:
+        pass
+
+    def _dispatch(self, fields: list) -> None:
+        pass
+
+    def _pump_until(self, done: Callable[[], bool],
+                    timeout_s: float, what: str) -> None:
+        assert self.proc is not None
+        deadline = time.monotonic() + timeout_s
+        while not done():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{type(self).__name__}: {what} not reached "
+                    f"in {timeout_s}s")
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"{type(self).__name__} child died before {what}")
+            self._dispatch(line.split())
+
+    @property
+    def pid(self) -> int:
+        assert self.proc is not None
+        return self.proc.pid
+
+    def kill(self) -> None:
+        """SIGKILL — preemption: no cleanup code runs; whatever write
+        was mid-flight stays mid-written."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+    def __enter__(self):
+        return self.start()          # type: ignore[attr-defined]
+
+    def __exit__(self, *exc) -> None:
+        self.kill()
+
+
+_TRAINER_SCRIPT = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+(save_dir, n_passes, interval_s, keep, seed_base,
+ fleet_addr, fleet_id, parent_ctx) = sys.argv[1:9]
+from paddle_tpu.utils import FLAGS
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+if fleet_addr:
+    FLAGS.set("fleet_addr", fleet_addr)
+    FLAGS.set("fleet_id", fleet_id)
+    FLAGS.set("fleet_role", "trainer")
+    FLAGS.set("metrics_interval_s", 0.2)
+    trace.ensure_ring()          # spans ride the push frames
+    observe.start_from_flags()
+from paddle_tpu.serving.model import DecoderConfig, init_decoder_params
+from paddle_tpu.trainer.checkpoint import save_checkpoint
+cfg = DecoderConfig(vocab=64, dim=32, heads=2, layers=1, ffn=64,
+                    max_context=64, eos_id=1)
+ctx = trace.parse_header(parent_ctx) if parent_ctx else None
+print("READY", os.getpid(), flush=True)
+i = 0
+while int(n_passes) < 0 or i < int(n_passes):
+    # a fresh seed per pass: every checkpoint has a distinct digest, so
+    # the watcher's exactly-once set is actually exercised (seed_base
+    # shifts a RESTARTED trainer onto digests it never saved before)
+    params = init_decoder_params(cfg, seed=int(seed_base) + i)
+    with trace.context_scope(ctx):
+        save_checkpoint(save_dir, i, params, keep=int(keep))
+    print("SAVED", i, flush=True)
+    i += 1
+    time.sleep(float(interval_s))
+while True:
+    time.sleep(3600)
+"""
+
+
+class TrainerLoopProcess(_LineChild):
+    """A trainer child saving real (tiny-decoder) checkpoints in a
+    loop — one ``SAVED n`` line per pass, each pass a distinct digest.
+    ``kill()`` lands SIGKILL mid-loop (often mid-save: a ``.tmp-ckpt-*``
+    dir in flight), which the checkpoint format must shrug off."""
+
+    def __init__(self, save_dir: str, n_passes: int = -1,
+                 interval_s: float = 0.05, keep: int = 3,
+                 seed_base: int = 0,
+                 fleet_addr: str = "", fleet_id: str = "",
+                 parent_ctx: str = ""):
+        self.save_dir = save_dir
+        self.n_passes = n_passes
+        self.interval_s = interval_s
+        self.keep = keep
+        self.seed_base = seed_base
+        self.fleet_addr = fleet_addr
+        self.fleet_id = fleet_id
+        self.parent_ctx = parent_ctx
+        self.saved = 0          # SAVED lines seen so far
+
+    def start(self, ready_timeout_s: float = 120.0
+              ) -> "TrainerLoopProcess":
+        self.saved = 0
+        self._spawn(_TRAINER_SCRIPT,
+                    [self.save_dir, self.n_passes, self.interval_s,
+                     self.keep, self.seed_base, self.fleet_addr,
+                     self.fleet_id, self.parent_ctx], ready_timeout_s)
+        return self
+
+    def _dispatch(self, fields: list) -> None:
+        if fields and fields[0] == "SAVED":
+            self.saved = int(fields[1]) + 1
+
+    def wait_saved(self, n: int, timeout_s: float = 120.0) -> int:
+        """Block until the child has completed ``n`` checkpoint saves;
+        returns the number completed."""
+        self._pump_until(lambda: self.saved >= n, timeout_s,
+                         f"{n} checkpoint saves")
+        return self.saved
+
+
+_EXPORTER_SCRIPT = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+(save_dir, export_dir, poll_s, quantize,
+ fleet_addr, fleet_id, parent_ctx) = sys.argv[1:8]
+from paddle_tpu.utils import FLAGS
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+if fleet_addr:
+    FLAGS.set("fleet_addr", fleet_addr)
+    FLAGS.set("fleet_id", fleet_id)
+    FLAGS.set("fleet_role", "exporter")
+    FLAGS.set("metrics_interval_s", 0.2)
+    trace.ensure_ring()
+    observe.start_from_flags()
+from paddle_tpu.serving.model import DecoderConfig
+from paddle_tpu.serving.rollout import CheckpointWatcher
+cfg = DecoderConfig(vocab=64, dim=32, heads=2, layers=1, ffn=64,
+                    max_context=64, eos_id=1)
+w = CheckpointWatcher(save_dir, cfg, export_dir=export_dir,
+                      poll_s=float(poll_s), quantize=quantize or None)
+ctx = trace.parse_header(parent_ctx) if parent_ctx else None
+print("READY", os.getpid(), flush=True)
+while True:
+    with trace.context_scope(ctx):
+        arts = w.poll_once()
+    for a in arts:
+        print("EXPORTED", a, flush=True)
+    time.sleep(float(poll_s))
+"""
+
+
+class ExporterProcess(_LineChild):
+    """An exporter child running the real
+    :class:`~paddle_tpu.serving.rollout.CheckpointWatcher` poll loop
+    (export only — no server attached) — one ``EXPORTED <dir>`` line
+    per artifact.  ``kill()`` lands SIGKILL mid-export (a
+    ``.tmp-export-*`` dir in flight); a restarted exporter must
+    re-derive its exactly-once set from the artifacts themselves and
+    never re-export or half-publish."""
+
+    def __init__(self, save_dir: str, export_dir: str,
+                 poll_s: float = 0.1, quantize: str = "int8",
+                 fleet_addr: str = "", fleet_id: str = "",
+                 parent_ctx: str = ""):
+        self.save_dir = save_dir
+        self.export_dir = export_dir
+        self.poll_s = poll_s
+        self.quantize = quantize
+        self.fleet_addr = fleet_addr
+        self.fleet_id = fleet_id
+        self.parent_ctx = parent_ctx
+        self.exported: list = []     # artifact dirs, in export order
+
+    def start(self, ready_timeout_s: float = 120.0) -> "ExporterProcess":
+        self.exported = []
+        self._spawn(_EXPORTER_SCRIPT,
+                    [self.save_dir, self.export_dir, self.poll_s,
+                     self.quantize, self.fleet_addr, self.fleet_id,
+                     self.parent_ctx], ready_timeout_s)
+        return self
+
+    def _dispatch(self, fields: list) -> None:
+        if fields and fields[0] == "EXPORTED":
+            self.exported.append(fields[1])
+
+    def wait_exported(self, n: int, timeout_s: float = 120.0) -> list:
+        """Block until ``n`` artifacts have been exported (counted from
+        this start()); returns the artifact dir list so far."""
+        self._pump_until(lambda: len(self.exported) >= n, timeout_s,
+                         f"{n} artifact exports")
+        return list(self.exported)
+
+
+_ROLLOUT_SERVE_SCRIPT = r"""
+import os, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+(export_dir, poll_s, inflight, serve_load,
+ fleet_addr, fleet_id, parent_ctx) = sys.argv[1:8]
+from paddle_tpu.utils import FLAGS
+from paddle_tpu import observe
+from paddle_tpu.observe import trace
+if fleet_addr:
+    FLAGS.set("fleet_addr", fleet_addr)
+    FLAGS.set("fleet_id", fleet_id)
+    FLAGS.set("fleet_role", "serving")
+    FLAGS.set("metrics_interval_s", 0.2)
+    trace.ensure_ring()
+    observe.start_from_flags()
+from paddle_tpu.serving.loader import artifact_digest, read_manifest
+from paddle_tpu.serving.model import (DecoderConfig, DecoderModel,
+                                      init_decoder_params)
+from paddle_tpu.serving.rollout import (latest_valid_artifact,
+                                        swap_from_artifact)
+from paddle_tpu.serving.server import InferenceServer
+cfg = DecoderConfig(vocab=64, dim=32, heads=2, layers=1, ffn=64,
+                    max_context=64, eos_id=1)
+# boot from the newest digest-valid artifact when one exists — the
+# restart-resumes-the-pipeline property the gauntlet asserts
+art = latest_valid_artifact(export_dir)
+if art:
+    model = DecoderModel.from_artifact(art)
+    version = artifact_digest(read_manifest(art))
+else:
+    model = DecoderModel(init_decoder_params(cfg, seed=0), cfg)
+    version = "seed"
+srv = InferenceServer(model, max_batch=4, n_pages=64, page_size=8,
+                      continuous=True, model_version=version).start()
+port = srv.start_http(0)
+ctx = trace.parse_header(parent_ctx) if parent_ctx else None
+
+def _watch():
+    while True:
+        time.sleep(float(poll_s))
+        a = latest_valid_artifact(export_dir)
+        if not a:
+            continue
+        with trace.context_scope(ctx):
+            rep = swap_from_artifact(srv, a, inflight=inflight or None)
+        if rep.get("result") == "ok":
+            print("SWAPPED", rep.get("version"), flush=True)
+
+threading.Thread(target=_watch, name="ptpu-rollout-swapper",
+                 daemon=True).start()
+print("READY", os.getpid(), port, version, flush=True)
+i = 0
+while serve_load == "1":
+    with trace.context_scope(ctx), trace.span("serve_request", i=i):
+        r = srv.submit([2 + (i % 60)] * (2 + i % 10), max_new_tokens=6)
+        toks = srv.result(r, timeout=60.0)
+    assert toks, "empty generation"
+    print("SERVED", i, srv.model_version, flush=True)
+    i += 1
+while True:
+    time.sleep(3600)
+"""
+
+
+class RolloutServeProcess(_LineChild):
+    """A serving replica child that hot-swaps every new artifact while
+    serving an endless request stream.
+
+    Boots from the newest digest-valid artifact in ``export_dir`` (or
+    seed weights when empty) and exposes the real HTTP front on an
+    ephemeral port (``.port``), so a :class:`RollingCoordinator` can
+    POST ``/v1/swap`` at it; a watcher thread inside the child also
+    swaps in whatever :func:`latest_valid_artifact` finds, so
+    ``kill()`` can land SIGKILL mid-swap.  Progress lines:
+    ``SWAPPED <version>`` per completed hot-swap and ``SERVED <i>
+    <version>`` per completed request — every response is stamped with
+    the version that served it, which is how the gauntlet proves
+    responses never mix model versions."""
+
+    def __init__(self, export_dir: str, poll_s: float = 0.1,
+                 inflight: str = "drain", serve_load: bool = True,
+                 fleet_addr: str = "", fleet_id: str = "",
+                 parent_ctx: str = ""):
+        self.export_dir = export_dir
+        self.poll_s = poll_s
+        self.inflight = inflight
+        self.serve_load = serve_load
+        self.fleet_addr = fleet_addr
+        self.fleet_id = fleet_id
+        self.parent_ctx = parent_ctx
+        self.port = 0
+        self.boot_version = ""
+        self.served = 0
+        self.swaps: list = []            # versions, in swap order
+        self.served_versions: list = []  # (request index, version)
+
+    def start(self, ready_timeout_s: float = 120.0
+              ) -> "RolloutServeProcess":
+        self.served = 0
+        self.swaps = []
+        self.served_versions = []
+        self._spawn(_ROLLOUT_SERVE_SCRIPT,
+                    [self.export_dir, self.poll_s, self.inflight,
+                     "1" if self.serve_load else "0", self.fleet_addr,
+                     self.fleet_id, self.parent_ctx], ready_timeout_s)
+        return self
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _on_ready(self, fields: list) -> None:
+        self.port = int(fields[2])
+        self.boot_version = fields[3]
+
+    def _dispatch(self, fields: list) -> None:
+        if not fields:
+            return
+        if fields[0] == "SWAPPED":
+            self.swaps.append(fields[1])
+        elif fields[0] == "SERVED":
+            self.served = int(fields[1]) + 1
+            self.served_versions.append((int(fields[1]), fields[2]))
+
+    def wait_served(self, n: int, timeout_s: float = 120.0) -> int:
+        """Block until ``n`` requests completed; returns the count."""
+        self._pump_until(lambda: self.served >= n, timeout_s,
+                         f"{n} served requests")
+        return self.served
+
+    def wait_swapped(self, n: int = 1, timeout_s: float = 120.0) -> list:
+        """Block until ``n`` hot-swaps completed (counted from this
+        start()); returns the swapped-in version list so far."""
+        self._pump_until(lambda: len(self.swaps) >= n, timeout_s,
+                         f"{n} hot-swaps")
+        return list(self.swaps)
+
+
 # ------------------------------------------------------- data faults
 class ShardFault(RuntimeError):
     """Raised by a poisoned ``load_fn`` (distinct type so tests can
@@ -467,6 +844,37 @@ def corrupt_checkpoint(ckpt_dir: str, fname: str = "params.npz",
     else:
         raise ValueError(f"unknown corruption mode {mode!r}")
     log.info("injected %s corruption into %s", mode, path)
+    return path
+
+
+# --------------------------------------------------- artifact faults
+def corrupt_artifact(artifact_dir: str, fname: str = "weights.npz",
+                     mode: str = "truncate") -> str:
+    """Damage one file of a serving artifact AFTER its digests were
+    recorded in the manifest — the torn-artifact case the rollout
+    verify gate (``loader.verify_artifact``) exists for.  Same damage
+    modes as :func:`corrupt_checkpoint`; returns the damaged path."""
+    return corrupt_checkpoint(artifact_dir, fname=fname, mode=mode)
+
+
+def resign_artifact_manifest(artifact_dir: str,
+                             fname: str = "weights.npz") -> str:
+    """Re-sign an artifact manifest with a WRONG digest for ``fname``
+    (sizes stay correct, so only the sha256 comparison can catch it) —
+    the malicious/buggy-writer case: the weights are intact but the
+    manifest lies about them.  Returns the manifest path."""
+    import json as _json
+
+    path = os.path.join(artifact_dir, "manifest.json")
+    with open(path) as f:
+        manifest = _json.load(f)
+    files = manifest.get("files") or {}
+    if fname not in files:
+        raise ValueError(f"manifest has no digest entry for {fname!r}")
+    files[fname]["sha256"] = "0" * 64
+    with open(path, "w") as f:
+        _json.dump(manifest, f, indent=1)
+    log.info("re-signed %s with wrong digest for %s", path, fname)
     return path
 
 

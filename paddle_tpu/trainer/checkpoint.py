@@ -18,13 +18,14 @@ each save.  ``--ckpt_verify=false`` restores the legacy blind load.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
 import shutil
 import tempfile
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import jax
 import numpy as np
@@ -405,6 +406,76 @@ def latest_valid_checkpoint(save_dir: str,
     return None
 
 
+def checkpoint_digest(ckpt_dir: str) -> Optional[str]:
+    """Content-stable identity of a checkpoint: sha256 over the sorted
+    per-file digests in its manifest.  This is the exactly-once key the
+    export watcher uses (``serving/rollout.py``) — re-saving identical
+    bytes under a new pass id gets the same digest; any data change
+    changes it.  None when the manifest is unreadable or predates
+    digest recording (``--ckpt_verify=false`` saves)."""
+    try:
+        files = load_manifest(ckpt_dir).get("files")
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return None
+    if not files:
+        return None
+    h = hashlib.sha256()
+    for fname in sorted(files):
+        h.update(fname.encode())
+        h.update(str(files[fname].get("sha256")).encode())
+    return h.hexdigest()
+
+
+# ------------------------------------------------- export pin / lease
+def _export_markers(ckpt_dir: str) -> List[str]:
+    try:
+        return [os.path.join(ckpt_dir, n) for n in os.listdir(ckpt_dir)
+                if n.startswith(".exporting-")]
+    except OSError:
+        return []
+
+
+def export_pinned(ckpt_dir: str) -> bool:
+    """True when a live export lease pins ``ckpt_dir`` against the
+    retention sweep: some ``.exporting-<pid>`` marker inside it has an
+    mtime fresher than ``--ckpt_export_lease_s``.  Stale markers (a
+    SIGKILLed exporter never removes its marker) expire by mtime, so a
+    dead exporter cannot pin a checkpoint forever."""
+    lease_s = FLAGS.ckpt_export_lease_s
+    now = time.time()
+    for path in _export_markers(ckpt_dir):
+        try:
+            if now - os.path.getmtime(path) < lease_s:
+                return True
+        except OSError:
+            continue        # marker vanished between listdir and stat
+    return False
+
+
+@contextlib.contextmanager
+def export_lease(ckpt_dir: str) -> Iterator[str]:
+    """Pin ``ckpt_dir`` for the duration of an export.
+
+    Writes a ``.exporting-<pid>`` marker INSIDE the checkpoint dir
+    (same-directory so the pin travels with the dir and needs no
+    side-channel registry); :func:`sweep_retention` skips pinned pass
+    dirs, closing the race where a slow export loses its source mid-
+    read.  The marker is removed on exit; if the exporter is SIGKILLed
+    the marker goes stale and expires via ``--ckpt_export_lease_s``.
+    """
+    marker = os.path.join(ckpt_dir, f".exporting-{os.getpid()}")
+    with open(marker, "w") as f:
+        f.write(str(time.time()))
+    try:
+        yield marker
+    finally:
+        try:
+            os.remove(marker)
+        except OSError:
+            pass        # dir already reaped (lease expired) or marker
+            # removed by hand — nothing left to unpin
+
+
 # a .tmp-ckpt-* dir older than this is an orphan from a save that was
 # SIGKILLed mid-write (no in-process cleanup ran); no live save under
 # the election window ever takes this long
@@ -447,6 +518,16 @@ def sweep_retention(save_dir: str, keep: Optional[int] = None) -> List[str]:
         for name in _pass_dirs(save_dir)[:-keep] + corrupt[:-keep] \
                 + _stale_tmp_dirs(save_dir):
             path = os.path.join(save_dir, name)
+            if export_pinned(path):
+                # an exporter holds a live lease on this dir — reaping
+                # it now would tear the artifact mid-read.  The NEXT
+                # sweep gets it once the lease is released or expires.
+                counter("ckpt_retention_pinned",
+                        "retention-eligible checkpoint dirs skipped "
+                        "because a live export lease pins them").inc()
+                log.info("retention sweep: %s pinned by export lease, "
+                         "skipping", name)
+                continue
             try:
                 shutil.rmtree(path)
             except OSError as e:

@@ -383,6 +383,42 @@ FLAGS.define("serve_slo_ms", 0.0,
              "optional p99 TTFT SLO in milliseconds: when > 0 the "
              "server's /healthz and the bench serving lane report "
              "slo_met from the serve_ttft_seconds reservoir p99")
+FLAGS.define("rollout", True,
+             "the zero-downtime train->serve pipeline "
+             "(serving/rollout.py): checkpoint watcher + atomic "
+             "hot-swap of exported artifacts into the live "
+             "InferenceServer between decode steps, with automatic "
+             "rollback on a failed verify/load/probe.  false is the "
+             "kill switch: request_swap refuses, POST /v1/swap is an "
+             "unknown path, and /healthz carries exactly the PR-15 "
+             "body — the server is byte-identical to pre-rollout "
+             "behavior")
+FLAGS.define("rollout_poll_s", 5.0,
+             "checkpoint-watcher poll interval (serving/rollout.py): "
+             "how often the watcher rescans --save_dir for a new "
+             "digest-verified retained checkpoint to export")
+FLAGS.define("rollout_inflight", "drain",
+             "what happens to in-flight sequences at the hot-swap "
+             "pointer flip: 'drain' finishes them on the OLD model "
+             "before flipping (admissions pause, zero recompute); "
+             "'reprefill' flips immediately and restarts their "
+             "generation from the prompt on the NEW model (tokens "
+             "generated so far are discarded — a response always "
+             "comes from exactly one model under BOTH policies)")
+FLAGS.define("rollout_quantize", "int8",
+             "serving-artifact quantization the watcher's export uses "
+             "(int8 per-channel weights-only, or 'none' for raw fp32 "
+             "— same schemes as export_decoder)")
+FLAGS.define("rollout_export_dir", "",
+             "directory the checkpoint watcher writes serving "
+             "artifacts into (model-<digest> dirs, atomic tmp+rename; "
+             "empty = <save_dir>/export)")
+FLAGS.define("ckpt_export_lease_s", 600.0,
+             "stale-mtime expiry for .exporting-<pid> checkpoint pin "
+             "markers (trainer/checkpoint.py): the retention sweep "
+             "honors a fresher marker (never reaps a checkpoint "
+             "mid-export) and ignores older ones — a SIGKILLed "
+             "exporter cannot pin a checkpoint forever")
 FLAGS.define("sparse_grads", True,
              "sparse gradient exchange for ParamAttr(sparse_update="
              "True) embedding tables (parallel/sparse.py): the jitted "

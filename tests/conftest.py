@@ -214,9 +214,10 @@ def _io_thread_leak_guard(request):
     from paddle_tpu.observe.trace import WRITER_THREAD_NAME
 
     # "ptpu-serve-" covers the inference server's decode + HTTP threads
-    # (serving/server.py) without importing the serving stack here
+    # (serving/server.py), "ptpu-rollout-" the checkpoint watcher
+    # (serving/rollout.py) — without importing the serving stack here
     prefixes = (IO_THREAD_PREFIX, WRITER_THREAD_NAME, SERVER_THREAD_NAME,
-                AGGREGATOR_THREAD_NAME, "ptpu-serve-")
+                AGGREGATOR_THREAD_NAME, "ptpu-serve-", "ptpu-rollout-")
 
     def stray():
         return [t for t in threading.enumerate()

@@ -365,6 +365,45 @@ def test_committed_baseline_carries_sparse_series():
         tr["dense"]["exchanged_grad_bytes"]
 
 
+def test_committed_baseline_carries_rollout_series():
+    """The train→serve rollout lane is part of the committed artifact:
+    the swap-window-over-steady TTFT p99 degradation headline (lower
+    is better — 1.0 means a hot-swap in the measurement window is
+    free) plus both modes' req/s + p99 rows, with the zero-downtime
+    contract (no failed requests, real swaps, sub-decode-step pause)
+    stamped on the line."""
+    doc = _committed()
+    keys = [k for k in doc["series"] if k.startswith("rollout")]
+    assert "rollout_swap_p99_degradation" in keys
+    assert doc["series"]["rollout_swap_p99_degradation"][
+        "direction"] == "lower"
+    for mode in ("steady", "swap"):
+        rps = f"rollout_swap_p99_degradation.live_swap.{mode}_req_per_sec"
+        p99 = f"rollout_swap_p99_degradation.live_swap.{mode}_p99_ms"
+        assert rps in keys and p99 in keys
+        assert doc["series"][rps]["direction"] == "higher"
+        assert doc["series"][p99]["direction"] == "lower"
+    line = next(l for l in doc["lines"]
+                if l["metric"] == "rollout_swap_p99_degradation")
+    assert line["failed_requests"] == 0      # zero-downtime contract
+    assert line["swaps"] >= 2                # every window really swapped
+    assert line["swap_pause_ms_p50"] < 1000.0
+    row = next(r for r in line["rows"] if r["workload"] == "live_swap")
+    assert row["steady"]["req_per_sec"] > 0
+    assert row["swap"]["req_per_sec"] > 0
+
+
+def test_live_rollout_lane_passes_committed_gate():
+    """Acceptance shape: actually run the rollout lane (two int8
+    exports, a real hot-swap inside every timed window, the in-lane
+    zero-failed-requests assert — which raises on violation) and hold
+    its steady/swap req/s + p99 series against the committed
+    baseline."""
+    rc = _bench_main(["--only", "rollout", "--rollout_small",
+                      "--baseline", BASELINE, "--check"])
+    assert rc == 0
+
+
 def test_live_sparse_lane_passes_committed_gate():
     """Acceptance shape: actually run the sparse embedding lane
     (lookup scan, dense-vs-sparse-exchange train A/B at 10\u2076 rows,
