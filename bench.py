@@ -1060,11 +1060,16 @@ def bench_rollout():
     try:
         arts = []
         for seed in (0, 1):
-            d = os.path.join(tmp, f"art-{seed}")
+            # canonical model-<digest12> names: the canary bake's
+            # rollback resolves its predecessor by that convention
+            d0 = os.path.join(tmp, f"stage-{seed}")
             export_decoder(
                 {k: np.asarray(v) for k, v in
                  init_decoder_params(cfg, seed=seed).items()},
-                cfg, d, quantize="int8")
+                cfg, d0, quantize="int8")
+            dig = artifact_digest(read_manifest(d0))
+            d = os.path.join(tmp, f"model-{dig[:12]}")
+            os.rename(d0, d)
             arts.append(d)
         mdl = DecoderModel.from_artifact(arts[0])
         srv = InferenceServer(
@@ -1119,6 +1124,43 @@ def bench_rollout():
                     float(np.percentile(swap_t[-1], 99))
                     / max(float(np.percentile(steady_t[-1], 99)),
                           1e-9))
+            # canary-bake sub-lane (ISSUE 20): the bake must catch a
+            # seeded-slow artifact (manifest debug_prefill_delay_ms)
+            # and auto-roll-back, and must PROMOTE a clean one — with
+            # zero failed requests either way.  The windowed TTFT
+            # baseline is already warm from the timed passes above.
+            from paddle_tpu.observe import REGISTRY as _reg
+            from paddle_tpu.serving import rollout as ro
+
+            # the seeded regression must clear the bake's 2x verdict
+            # over the LIVE 60s window — which at this point holds the
+            # timed passes' open-loop queue waits, so the delay is
+            # sized off the measured window, not a magic constant
+            _h = _reg.find("serve_ttft_seconds")
+            base_p99 = (_h.window_quantile(0.99, 60.0)
+                        if _h is not None else None) or 0.1
+            delay_ms = int(max(2.5 * base_p99, 0.5) * 1e3)
+            slow = os.path.join(tmp, "art-slow")
+            export_decoder(
+                {k: np.asarray(v) for k, v in
+                 init_decoder_params(cfg, seed=2).items()},
+                cfg, slow, quantize="int8",
+                extra_meta={"debug_prefill_delay_ms": delay_ms})
+            factor = 2.0
+            bakes = {"bad": delay_ms / 1e3 + 2.5, "good": 2.5}
+            canary_failed, canary_reports = 0, {}
+            for tag, art in (("bad", slow), ("good", arts[1 - current])):
+                # requests decode THROUGH the bake, so the canary's
+                # windowed p99 is judged on live traffic
+                reqs = [srv.submit(p, max_new) for p in prompts]
+                canary_reports[tag] = ro.swap_from_artifact(
+                    srv, art, canary=True, bake_s=bakes[tag],
+                    canary_factor=factor)
+                for q in reqs:
+                    try:
+                        srv.result(q, timeout=600.0)
+                    except Exception:   # noqa: BLE001 — asserted zero
+                        canary_failed += 1
         finally:
             srv.stop()
     finally:
@@ -1127,6 +1169,19 @@ def bench_rollout():
         raise RuntimeError(
             f"zero-downtime contract violated: {failed} request(s) "
             "failed during the rollout lane")
+    bad, good = canary_reports["bad"], canary_reports["good"]
+    if bad.get("result") != "rolled_back" or \
+            bad.get("canary", {}).get("rollback") != "ok":
+        raise RuntimeError(
+            "canary bake failed to roll back the seeded-slow "
+            f"artifact: {bad}")
+    if good.get("canary", {}).get("result") != "promoted":
+        raise RuntimeError(
+            f"canary bake failed to promote a clean artifact: {good}")
+    if canary_failed:
+        raise RuntimeError(
+            f"zero-downtime contract violated: {canary_failed} "
+            "request(s) failed during the canary bakes")
 
     def _mode(walls, ttfts):
         flat = [x for t in ttfts for x in t]
@@ -1146,7 +1201,18 @@ def bench_rollout():
         "scale": "small" if ROLLOUT_SMALL else "bench",
         "rows": [{"workload": "live_swap",
                   "steady": _mode(steady_w, steady_t),
-                  "swap": _mode(swap_w, swap_t)}],
+                  "swap": _mode(swap_w, swap_t)},
+                 # the GOOD bake's windowed p99 vs its pre-swap
+                 # baseline window — gated like any serving tail; the
+                 # detection outcomes themselves are asserted above
+                 # (a lane that stops detecting regressions errors,
+                 # and an errored lane regresses unconditionally)
+                 {"workload": "canary_bake",
+                  "steady": {"p99_ms": round(float(
+                      good["canary"]["baseline_p99_s"] or 0.0)
+                      * 1e3, 3)},
+                  "swap": {"p99_ms": round(float(
+                      good["canary"]["p99_s"] or 0.0) * 1e3, 3)}}],
         "failed_requests": failed,
         "swaps": len(reports),
         "inflight_policy": str(FLAGS.get("rollout_inflight")),
@@ -1160,6 +1226,20 @@ def bench_rollout():
                             "serving process; the in-place hot-swap "
                             "is the yardstick-free rebuild surface",
     }, values=degr)
+    r["canary"] = {
+        "bake_s": bakes, "factor": factor,
+        "injected_delay_ms": delay_ms,
+        "failed_requests": canary_failed,
+        "bad_bake": {
+            "result": bad["result"],               # "rolled_back"
+            "rollback": bad["canary"]["rollback"],
+            "reason": bad["canary"]["reason"],
+            "p99_ms": round(float(
+                bad["canary"]["p99_s"] or 0.0) * 1e3, 3),
+            "baseline_p99_ms": round(float(
+                bad["canary"]["baseline_p99_s"] or 0.0) * 1e3, 3)},
+        "good_bake": {"result": good["canary"]["result"]},  # promoted
+    }
     r["perf_stamp_of"] = "decode_step"
     return _decoder_observatory_stamp(
         r, DecoderModel(init_decoder_params(cfg, seed=0), cfg), cfg,

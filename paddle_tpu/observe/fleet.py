@@ -278,6 +278,8 @@ class FleetState:
                    else prev.get("spans_dropped", 0)),
                 "serving": frame.get("serving")
                 if isinstance(frame.get("serving"), dict) else {},
+                "slo": frame.get("slo")
+                if isinstance(frame.get("slo"), dict) else {},
             }
             self._procs[name] = entry
             # a restart KEEPS the predecessor incarnation's spans (the
@@ -319,7 +321,13 @@ class FleetState:
         if age > self.stale_factor * max(e["interval_s"], 1e-3):
             return _MISSING
         status = str(e["health"].get("status", _OK))
-        return status if status in (_OK, _DEGRADED) else _DEGRADED
+        if status not in (_OK, _DEGRADED):
+            return _DEGRADED
+        # a standing SLO breach marks the process degraded — the
+        # breached objective is named in the rollup entry
+        if status == _OK and (e.get("slo") or {}).get("status") == "breach":
+            return _DEGRADED
+        return status
 
     def rollup(self) -> Dict[str, Any]:
         """The ``/fleet/healthz`` body: per-process status + cluster
@@ -345,6 +353,12 @@ class FleetState:
                 "seq": e["seq"], "uptime_s": round(e["uptime_s"], 3),
                 "restarts": e["restarts"],
             }
+            slo = e.get("slo") or {}
+            if slo:
+                procs[name]["slo"] = str(slo.get("status", "?"))
+                if slo.get("breached"):
+                    # name WHICH objective degraded this process
+                    procs[name]["slo_breached"] = list(slo["breached"])
         if counts[_MISSING]:
             status = _MISSING
         elif counts[_DEGRADED]:
@@ -396,6 +410,17 @@ class FleetState:
                     "exported_at")
                 if serving.get("swap_error"):
                     procs[name]["swap_error"] = serving["swap_error"]
+                # windowed serving signals (PR 20): what the canary
+                # bake compares across replicas
+                if serving.get("ttft_p99_s") is not None:
+                    procs[name]["ttft_p99_s"] = serving["ttft_p99_s"]
+                if serving.get("error_rate_s") is not None:
+                    procs[name]["error_rate_s"] = serving["error_rate_s"]
+            slo = e.get("slo") or {}
+            if slo:
+                procs[name]["slo"] = str(slo.get("status", "?"))
+                if slo.get("breached"):
+                    procs[name]["slo_breached"] = list(slo["breached"])
         return {"schema": FLEET_SCHEMA, "pid": os.getpid(),
                 "procs": procs}
 
@@ -533,6 +558,8 @@ class FleetState:
                 "health": str(e["health"].get("status", "?")),
                 "version": (e.get("serving") or {}).get("model_version"),
                 "rollout": (e.get("serving") or {}).get("rollout_state"),
+                "ttft_p99_s": (e.get("serving") or {}).get("ttft_p99_s"),
+                "slo": (e.get("slo") or {}).get("status"),
             })
         return rows
 
@@ -834,6 +861,24 @@ class FleetPusher:
             # additive, optional: only processes that loaded a serving
             # model carry it, and older aggregators ignore the key
             frame["serving"] = serving
+            # windowed serving signals ride the frame so the canary
+            # bake can compare replicas fleet-side (sys.modules read:
+            # the registry was imported long before any pusher exists)
+            hist = self.registry.find("serve_ttft_seconds")
+            if hist is not None and hasattr(hist, "window_quantile"):
+                p99 = hist.window_quantile(0.99, 60.0)
+                if p99 is not None:
+                    serving["ttft_p99_s"] = round(p99, 6)
+            errs = self.registry.find("serve_request_failures")
+            if errs is not None and hasattr(errs, "window_rate"):
+                serving["error_rate_s"] = round(
+                    errs.window_rate(60.0), 6)
+        # SLO verdicts (additive, optional — same discipline); the
+        # reporter evaluated right before this push, so last() is fresh
+        smod = sys.modules.get("paddle_tpu.observe.slo")
+        eng = smod.active_engine() if smod is not None else None
+        if eng is not None:
+            frame["slo"] = eng.frame_digest()
         return frame
 
     # ------------------------------------------------------------- push
@@ -1019,7 +1064,8 @@ def render_watch(rollup_doc: Dict[str, Any],
                        sorted(rollup_doc.get("counts", {}).items())
                        if v))
     cols = ["proc", "role", "pid", "status", "step/s", "input_bound",
-            "hbm_peak", "health", "version", "last_seen"]
+            "hbm_peak", "health", "version", "p99_ttft", "slo",
+            "last_seen"]
     table: List[List[str]] = [cols]
     for r in rows:
         version = r.get("version")
@@ -1033,6 +1079,7 @@ def render_watch(rollup_doc: Dict[str, Any],
             vcell = str(version)[:12]
             if rollout and rollout != "serving":
                 vcell = f"{vcell[:6]}…({rollout})"
+        p99 = r.get("ttft_p99_s")
         table.append([
             str(r["proc"]), str(r["role"]), str(r["pid"]),
             str(r["status"]),
@@ -1041,7 +1088,10 @@ def render_watch(rollup_doc: Dict[str, Any],
             "-" if r["input_bound"] is None
             else f"{r['input_bound']:.3f}",
             _fmt_bytes(r["hbm_peak_bytes"]),
-            str(r["health"]), vcell, f"{r['last_seen_s']:.1f}s",
+            str(r["health"]), vcell,
+            "-" if p99 is None else f"{p99 * 1e3:.0f}ms",
+            str(r.get("slo") or "-"),
+            f"{r['last_seen_s']:.1f}s",
         ])
     widths = [max(len(row[i]) for row in table)
               for i in range(len(cols))]
@@ -1080,6 +1130,8 @@ def watch_once(addr: str) -> str:
             "health": p.get("health", "?"),
             "version": p.get("model_version"),
             "rollout": p.get("rollout_state"),
+            "ttft_p99_s": p.get("ttft_p99_s"),
+            "slo": p.get("slo"),
         })
     # headline metrics come from the merged exposition
     summaries: List[str] = []

@@ -20,9 +20,15 @@ a live run scrapeable without the JSONL sinks:
   of this process (``observe/costmodel.py``), JSON;
 - ``GET /health``   — the most recent drained training-health report
   (``observe/health.py``): per-layer grad/param norms, update ratios,
-  non-finite localization, recent alerts — detail beyond ``/healthz``.
+  non-finite localization, recent alerts — detail beyond ``/healthz``;
+- ``GET /slo``      — a FRESH evaluation of every ``--slo`` objective
+  (``observe/slo.py``): ok/breach + fast/slow burn rates per
+  objective (404 when no engine is configured).  A standing breach
+  also rides ``/healthz`` (status degrades to ``"degraded"`` — code
+  stays 200, same degraded-but-ALIVE stance as health alerts).
 
-``/roofline`` and ``/health`` follow the ``/trace`` lazy discipline:
+``/roofline``, ``/health`` and ``/slo`` follow the ``/trace`` lazy
+discipline:
 they read module state that only exists once the producing subsystem
 ran (imports resolved at request time through ``sys.modules``), so a
 ``/metrics``-only run never imports — let alone pays for — either.
@@ -138,7 +144,31 @@ class _Handler(BaseHTTPRequestHandler):
                     # degraded-but-ALIVE: detail degrades, the HTTP
                     # code stays 200 — never invite a kill
                     payload["status"] = payload["health"]["status"]
+                # same discipline for the SLO engine: --slo unset →
+                # module never imported → byte-identical body
+                smod = sys.modules.get("paddle_tpu.observe.slo")
+                eng = smod.active_engine() if smod is not None else None
+                if eng is not None:
+                    digest = eng.frame_digest()
+                    payload["slo"] = digest
+                    if digest["status"] == "breach" \
+                            and payload["status"] == "ok":
+                        payload["status"] = "degraded"
                 self._send(200, json.dumps(payload), "application/json")
+            elif path == "/slo":
+                smod = sys.modules.get("paddle_tpu.observe.slo")
+                eng = smod.active_engine() if smod is not None else None
+                if eng is None:
+                    self._send(404, json.dumps(
+                        {"error": "no SLO engine configured (set "
+                                  "--slo 'metric:p99<0.5:60s')"}),
+                        "application/json")
+                else:
+                    # FRESH evaluation — scrape-time truth, matching
+                    # /metrics semantics (the reporter-interval cadence
+                    # still drives the gauges and fleet frames)
+                    self._send(200, json.dumps(eng.status_doc()),
+                               "application/json")
             elif path == "/trace":
                 # lazy opt-in: the FIRST /trace request enables
                 # ring-only recording — fence-free (trace.fences_steps
@@ -177,7 +207,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": "unknown path",
                      "paths": ["/metrics", "/healthz", "/trace",
-                               "/roofline", "/health"]}),
+                               "/roofline", "/health", "/slo"]}),
                     "application/json")
         except BrokenPipeError:      # scraper hung up mid-response
             pass
@@ -259,7 +289,7 @@ def start_from_flags() -> Optional[ObservabilityServer]:
                 return None
             get_logger("observe").info(
                 "observability endpoint on http://%s:%d "
-                "(/metrics /healthz /trace /roofline /health)",
+                "(/metrics /healthz /trace /roofline /health /slo)",
                 host, _global.port)
     return _global
 

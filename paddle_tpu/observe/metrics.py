@@ -27,12 +27,14 @@ independent sample series, Prometheus-style::
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import math
 import random
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, \
+    Sequence, Tuple
 
 from ..analysis.lockorder import named_lock
 
@@ -144,6 +146,19 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 #: million-observation training run at constant memory per series.
 DEFAULT_SAMPLE_CAP = 2048
 
+#: Windowed-reservoir geometry.  Each histogram series additionally
+#: keeps a time-bucketed ring of raw samples: ``WINDOW_BUCKETS``
+#: buckets of ``WINDOW_BUCKET_S`` seconds each (the ring spans
+#: bucket_s × buckets seconds — 360 s at the defaults, wide enough for
+#: a 60 s fast window AND its slow confirmation window,
+#: :mod:`paddle_tpu.observe.slo`), at most ``WINDOW_SAMPLE_CAP`` raw
+#: samples per bucket (Algorithm R within the bucket).  Memory per
+#: series is therefore bounded by buckets × cap floats no matter how
+#: long the process observes.
+WINDOW_BUCKET_S = 5.0
+WINDOW_BUCKETS = 72
+WINDOW_SAMPLE_CAP = 128
+
 
 class Histogram(_Metric):
     """Fixed-bucket histogram (Prometheus ``le`` convention: a bucket
@@ -156,13 +171,31 @@ class Histogram(_Metric):
     resolution — exact while the series is under the cap, an unbiased
     uniform-subsample estimate past it — where :meth:`quantile` is
     limited to bucket-interpolation resolution.  Retention never grows
-    past the cap no matter how long the run observes."""
+    past the cap no matter how long the run observes.
+
+    Each series ALSO keeps a **windowed reservoir**: a time-bucketed
+    ring of :data:`WINDOW_BUCKETS` buckets of ``window_bucket_s``
+    seconds, each bounded at ``window_cap`` raw samples (Algorithm R
+    within the bucket).  :meth:`window_quantile` /
+    :meth:`window_rate` / :meth:`window_count` answer "over the last N
+    seconds" — the primitive SLO verdicts, burn-rate alerts, and
+    canary comparisons need, which the LIFETIME reservoir cannot
+    (a recovered server's lifetime p99 advertises the bad minute
+    forever).  The observe-path cost is one clock read plus a ring
+    append under the same lock; the merge/sort work happens only when
+    a window is actually read, so a process that never reads a window
+    pays nothing beyond that.  ``clock`` is injectable (monotonic
+    seconds) so expiry is unit-testable with a fake clock."""
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "",
                  buckets: Optional[Sequence[float]] = None,
-                 sample_cap: Optional[int] = None):
+                 sample_cap: Optional[int] = None,
+                 window_bucket_s: Optional[float] = None,
+                 window_buckets: Optional[int] = None,
+                 window_cap: Optional[int] = None,
+                 clock: Optional[Callable[[], float]] = None):
         super().__init__(name, help)
         bs = tuple(sorted(buckets if buckets is not None
                           else DEFAULT_BUCKETS))
@@ -171,21 +204,41 @@ class Histogram(_Metric):
         self.buckets = bs
         self.sample_cap = DEFAULT_SAMPLE_CAP if sample_cap is None \
             else max(0, int(sample_cap))
+        self.window_bucket_s = float(WINDOW_BUCKET_S if window_bucket_s
+                                     is None else window_bucket_s)
+        if self.window_bucket_s <= 0:
+            raise ValueError(f"histogram {self.name!r}: window_bucket_s "
+                             "must be > 0")
+        self.window_buckets = max(1, int(WINDOW_BUCKETS if window_buckets
+                                         is None else window_buckets))
+        self.window_cap = max(0, int(WINDOW_SAMPLE_CAP if window_cap
+                                     is None else window_cap))
+        self._now = time.monotonic if clock is None else clock
         # reservoir replacement draws need no crypto strength; a
         # name-derived seed keeps runs reproducible
         self._rng = random.Random(name)
         # per label set: [per-bucket counts + overflow, sum, count,
-        #                 bounded sample reservoir]
+        #                 bounded sample reservoir, window ring] where
+        # the ring is a bounded deque of [bucket_id, count, sum,
+        # bounded samples] time buckets
         self._series: Dict[LabelKey, List[Any]] = {}
+
+    @property
+    def window_span_s(self) -> float:
+        """Widest answerable window: ring buckets × bucket width.
+        Wider queries clamp to it."""
+        return self.window_bucket_s * self.window_buckets
 
     def observe(self, value: float, **labels) -> None:
         value = float(value)     # numpy scalars would poison json.dumps
         key = _label_key(labels)
+        now = self._now() if self.window_cap else 0.0
         with self._lock:
             s = self._series.get(key)
             if s is None:
-                s = self._series[key] = [[0] * (len(self.buckets) + 1),
-                                         0.0, 0, []]
+                s = self._series[key] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0, [],
+                    collections.deque(maxlen=self.window_buckets)]
             counts = s[0]
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
@@ -205,6 +258,22 @@ class Histogram(_Metric):
                     j = self._rng.randrange(s[2])
                     if j < self.sample_cap:
                         res[j] = value
+            if self.window_cap:
+                bid = int(now // self.window_bucket_s)
+                ring = s[4]
+                b = ring[-1] if ring else None
+                if b is None or b[0] != bid:
+                    b = [bid, 0, 0.0, []]
+                    ring.append(b)   # maxlen evicts the oldest bucket
+                b[1] += 1
+                b[2] += value
+                ws = b[3]
+                if len(ws) < self.window_cap:
+                    ws.append(value)
+                else:
+                    j = self._rng.randrange(b[1])
+                    if j < self.window_cap:
+                        ws[j] = value
 
     @contextlib.contextmanager
     def time(self, **labels) -> Iterator[None]:
@@ -284,6 +353,80 @@ class Histogram(_Metric):
             s = self._series.get(_label_key(labels))
             return len(s[3]) if s else 0
 
+    # --------------------------------------------------------- windows
+    def _window_cut(self, window_s: float) -> Tuple[float, float]:
+        """(clamped window, cutoff time): a bucket whose interval ends
+        at or before the cutoff holds no sample younger than
+        ``window_s`` and is expired for this read."""
+        window_s = min(max(float(window_s), self.window_bucket_s),
+                       self.window_span_s)
+        return window_s, self._now() - window_s
+
+    def _window_ring(self, window_s: float, **labels
+                     ) -> Tuple[float, List[List[Any]]]:
+        """Lock-consistent copy of the ring buckets still inside the
+        window (newest data only; bucket granularity)."""
+        window_s, cutoff = self._window_cut(window_s)
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            ring = [[b[0], b[1], b[2], list(b[3])] for b in s[4]] \
+                if s else []
+        live = [b for b in ring
+                if (b[0] + 1) * self.window_bucket_s > cutoff]
+        return window_s, live
+
+    def window_count(self, window_s: float, **labels) -> int:
+        """Observations recorded in the last ``window_s`` seconds
+        (bucket granularity — a window narrower than one ring bucket
+        widens to it, one wider than the ring span clamps to it)."""
+        _, live = self._window_ring(window_s, **labels)
+        return sum(b[1] for b in live)
+
+    def window_rate(self, window_s: float, **labels) -> float:
+        """Observations per second over the last ``window_s`` seconds
+        (the error-rate reader when failures are observed as events)."""
+        window_s, live = self._window_ring(window_s, **labels)
+        return sum(b[1] for b in live) / window_s
+
+    def window_sum(self, window_s: float, **labels) -> float:
+        """Sum of observed values over the last ``window_s`` seconds."""
+        _, live = self._window_ring(window_s, **labels)
+        return sum(b[2] for b in live)
+
+    def window_samples(self, window_s: float, **labels) -> List[float]:
+        """The raw samples retained for the last ``window_s`` seconds
+        (unsorted; at most ``window_cap`` per ring bucket).  The SLO
+        engine's burn-rate reader: the violating fraction of these IS
+        the fraction of the error budget being burned."""
+        _, live = self._window_ring(window_s, **labels)
+        return [v for b in live for v in b[3]]
+
+    def window_quantile(self, q: float, window_s: float,
+                        **labels) -> Optional[float]:
+        """``q``-quantile over the last ``window_s`` seconds, from the
+        windowed reservoir: exact while the in-window buckets are under
+        their per-bucket cap, an unbiased uniform-subsample estimate
+        beyond (linear interpolation between order statistics, the
+        :meth:`sample_quantile` convention).  None with no in-window
+        samples — a recovered series goes back to None/ok instead of
+        advertising a stale bad quantile forever."""
+        res = self.window_samples(window_s, **labels)
+        if not res:
+            return None
+        res.sort()
+        pos = min(max(q, 0.0), 1.0) * (len(res) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(res) - 1)
+        return res[lo] + (res[hi] - res[lo]) * (pos - lo)
+
+    def window_retained(self, **labels) -> int:
+        """Raw samples currently held across the whole ring for this
+        series — bounded by buckets × window_cap by construction (the
+        cross-window monotone memory bound)."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return sum(len(b[3]) for b in s[4]) if s else 0
+
     def sum(self, **labels) -> float:
         with self._lock:
             s = self._series.get(_label_key(labels))
@@ -346,9 +489,18 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   buckets: Optional[Sequence[float]] = None,
-                  sample_cap: Optional[int] = None) -> Histogram:
+                  sample_cap: Optional[int] = None,
+                  **window_kw) -> Histogram:
         return self._get(Histogram, name, help, buckets=buckets,
-                         sample_cap=sample_cap)
+                         sample_cap=sample_cap, **window_kw)
+
+    def find(self, name: str) -> Optional[_Metric]:
+        """The registered metric of that name, or None — readers that
+        must not CREATE a series (the SLO evaluator, the fleet frame's
+        windowed-TTFT stamp) probe with this instead of the
+        get-or-create accessors."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def metrics(self) -> List[_Metric]:
         with self._lock:
@@ -432,7 +584,7 @@ def gauge(name: str, help: str = "") -> Gauge:
 
 def histogram(name: str, help: str = "",
               buckets: Optional[Sequence[float]] = None,
-              sample_cap: Optional[int] = None) -> Histogram:
+              sample_cap: Optional[int] = None, **window_kw) -> Histogram:
     # ptpu: lint-ok[PT-METRIC] forwarding shim; callers are the sites
     return REGISTRY.histogram(name, help, buckets=buckets,
-                              sample_cap=sample_cap)
+                              sample_cap=sample_cap, **window_kw)

@@ -155,6 +155,7 @@ class MetricsReporter:
 
         def loop():
             while not self._stop.wait(self.interval_s):
+                self._evaluate_slo()
                 try:
                     self.flush()
                 except Exception as e:  # noqa: BLE001 — telemetry never
@@ -171,6 +172,19 @@ class MetricsReporter:
             target=loop, name="ptpu-metrics-reporter", daemon=True)
         self._thread.start()
         return self
+
+    def _evaluate_slo(self) -> None:
+        """One SLO evaluation pass BEFORE the flush, so the verdicts
+        (and the ``slo_status``/``slo_burn_rate`` gauges) ride this
+        interval's JSONL line and fleet frame.  ``sys.modules`` probe:
+        an engine-less process (``--slo`` unset) pays one dict lookup
+        and nothing else."""
+        import sys
+
+        smod = sys.modules.get("paddle_tpu.observe.slo")
+        eng = smod.active_engine() if smod is not None else None
+        if eng is not None:
+            eng.evaluate()  # never raises (telemetry never kills)
 
     def _warn_flush_failure(self, e: Exception) -> None:
         from ..utils.logger import get_logger, warn_once
@@ -208,17 +222,28 @@ _global_lock = named_lock("observe.reporter.global")
 
 def start_from_flags() -> Optional[MetricsReporter]:
     """Start the process-wide reporter from ``--metrics_jsonl`` /
-    ``--fleet_addr`` / ``--metrics_interval_s``.  Idempotent; returns
-    the reporter (None when neither sink is configured — no thread
-    starts, no work happens).  Every long-running entry point calls
-    this once (``Trainer.train``, ``bench.main``, the CLI)."""
+    ``--fleet_addr`` / ``--metrics_interval_s`` / ``--slo``.
+    Idempotent; returns the reporter (None when no sink or SLO engine
+    is configured — no thread starts, no work happens).  ``--slo``
+    alone starts the reporter too: the engine needs the interval
+    thread to evaluate on even when nothing is exported.  Every
+    long-running entry point calls this once (``Trainer.train``,
+    ``bench.main``, the CLI)."""
     global _global
     from ..utils import FLAGS
 
     path = FLAGS.get("metrics_jsonl")
     fleet_addr = FLAGS.get("fleet_addr")
-    if not path and not fleet_addr:
+    slo_spec = str(FLAGS.get("slo") or "").strip()
+    if not path and not fleet_addr and not slo_spec:
         return _global
+    if slo_spec:
+        # import (not sys.modules probe): --slo set IS the opt-in that
+        # brings the engine into the process; every later surface
+        # probes sys.modules and now finds it
+        from . import slo as _slo
+
+        _slo.configure_from_flags()
     with _global_lock:
         if _global is None:
             _global = MetricsReporter(

@@ -268,7 +268,15 @@ class DecoderModel:
         weights = _loader.load_weight_entries(dirname, wsec)
         params = {e["name"]: w
                   for e, w in zip(wsec["entries"], weights)}
-        return cls(params, cfg)
+        model = cls(params, cfg)
+        # testing/bench knob (export_decoder extra_meta): a seeded-slow
+        # artifact carries debug_prefill_delay_ms in its manifest; the
+        # server's _prefill sleeps it inside the TTFT stamp so a canary
+        # bake has a deterministic latency regression to detect
+        delay_ms = manifest.get("debug_prefill_delay_ms")
+        if delay_ms:
+            model.debug_prefill_delay_s = float(delay_ms) / 1e3
+        return model
 
 
 def export_decoder(params: Dict[str, Any], cfg: DecoderConfig,

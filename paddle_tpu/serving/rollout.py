@@ -62,10 +62,11 @@ from .model import DecoderConfig, DecoderModel, export_decoder
 from .server import InferenceServer
 
 try:                         # telemetry optional, as in server.py
+    from ..observe import REGISTRY as _registry
     from ..observe import counter as _counter
     from ..observe import histogram as _histogram, trace as _trace
 except ImportError:  # pragma: no cover - standalone copy
-    _counter = _histogram = _trace = None
+    _counter = _histogram = _trace = _registry = None
 
 log = get_logger("serving")
 
@@ -260,6 +261,65 @@ def sweep_export_dir(export_dir: str, keep: Optional[int] = None
     return removed
 
 
+# --------------------------------------------------------- canary bake
+def _window_signals() -> Tuple[Optional[float], float]:
+    """This process's windowed serving signals: (p99 TTFT seconds or
+    None, failures/sec) over the last 60 s — the canary bake's
+    before/after comparison inputs."""
+    if _registry is None:
+        return None, 0.0
+    p99 = None
+    h = _registry.find("serve_ttft_seconds")
+    if h is not None and hasattr(h, "window_quantile"):
+        p99 = h.window_quantile(0.99, 60.0)
+    err = 0.0
+    f = _registry.find("serve_request_failures")
+    if f is not None and hasattr(f, "window_rate"):
+        err = f.window_rate(60.0)
+    return p99, err
+
+
+def _canary_verdict(p99: Optional[float], err: float,
+                    base_p99: Optional[float], base_err: float,
+                    factor: float) -> Optional[str]:
+    """None when the canary passes its bake, else the breach reason.
+
+    p99 is compared only when BOTH sides measured one (no traffic on
+    either side is no evidence).  A baseline error rate of zero makes
+    ANY canary errors a breach — an error-free pool sets the bar."""
+    if p99 is not None and base_p99 is not None and base_p99 > 0 \
+            and p99 > factor * base_p99:
+        return (f"canary p99 TTFT {p99 * 1e3:.1f}ms > {factor:g}x "
+                f"baseline {base_p99 * 1e3:.1f}ms")
+    err_bar = factor * base_err if base_err > 0 else 0.0
+    if err > err_bar:
+        if base_err > 0:
+            return (f"canary error rate {err:.4f}/s > {factor:g}x "
+                    f"baseline {base_err:.4f}/s")
+        return (f"canary error rate {err:.4f}/s on an error-free "
+                "baseline")
+    return None
+
+
+def _count_canary(result: str) -> None:
+    if _counter is not None:
+        _counter("rollout_canary_total",
+                 "canary bakes by outcome (promoted | rolled_back | "
+                 "missing)").inc(result=result)
+
+
+def previous_artifact_dir(artifact: str, prev_version: str
+                          ) -> Optional[str]:
+    """The sibling ``model-<digest12>`` dir a canary rolls back to, or
+    None when the predecessor artifact is gone (swept) or the server
+    never served an artifact (``unversioned``)."""
+    if not prev_version or "/" in prev_version:
+        return None
+    prev = os.path.join(os.path.dirname(artifact),
+                        f"{ARTIFACT_PREFIX}{prev_version[:12]}")
+    return prev if os.path.isdir(prev) else None
+
+
 # ------------------------------------------------------------ hot swap
 def _probe_model(model: DecoderModel) -> None:
     """First-inference probe: one tiny prefill on scratch pools.  A
@@ -279,7 +339,11 @@ def _probe_model(model: DecoderModel) -> None:
 
 def swap_from_artifact(server: InferenceServer, dirname: str,
                        inflight: Optional[str] = None,
-                       timeout_s: float = 120.0) -> Dict[str, Any]:
+                       timeout_s: float = 120.0,
+                       canary: Optional[bool] = None,
+                       bake_s: Optional[float] = None,
+                       canary_factor: Optional[float] = None
+                       ) -> Dict[str, Any]:
     """The full hot-swap pipeline against a live server.
 
     Verify → load → probe run on the CALLING thread (never the decode
@@ -288,9 +352,27 @@ def swap_from_artifact(server: InferenceServer, dirname: str,
     keeps serving, ``/healthz`` carries the reason, and
     ``rollout_swap_total{result}`` records which gate failed.  Returns
     the swap report (``result`` ∈ ``ok`` | ``unchanged`` |
-    ``rolled_back``)."""
+    ``rolled_back``).
+
+    With ``--rollout_canary`` and ``--rollout_bake_s > 0`` (or the
+    matching keyword overrides) a successful flip is followed by the
+    single-server **bake-then-commit window**: the windowed p99 TTFT /
+    error rate captured just before the flip become the baseline, the
+    new model serves for ``bake_s`` seconds, and a post-bake comparison
+    beyond ``canary_factor`` rolls BACK to the predecessor artifact
+    (reason on ``/healthz``, ``rollout_canary_total{result}``) — the
+    same policy :class:`RollingCoordinator` applies fleet-wide.  The
+    bake blocks the CALLING thread, never the decode loop."""
+    canary = bool(FLAGS.get("rollout_canary")) if canary is None \
+        else bool(canary)
+    bake_s = float(FLAGS.get("rollout_bake_s")) if bake_s is None \
+        else float(bake_s)
+    factor = float(FLAGS.get("rollout_canary_factor")) \
+        if canary_factor is None else float(canary_factor)
     t0 = time.perf_counter()
     report: Dict[str, Any] = {"artifact": dirname}
+    prev_version = server.model_version
+    baseline = _window_signals() if canary and bake_s > 0 else None
 
     def _fail(gate: str, e: Exception) -> Dict[str, Any]:
         reason = f"{gate}: {type(e).__name__}: {e}"
@@ -334,7 +416,57 @@ def swap_from_artifact(server: InferenceServer, dirname: str,
                    "end-to-end hot-swap latency: artifact verify + "
                    "model build + probe (off-thread) + pointer flip"
                    ).observe(report["swap_s"])
+    if canary and bake_s > 0 and report.get("result") == "ok":
+        report.update(_bake_single(
+            server, dirname, prev_version, baseline, bake_s, factor,
+            inflight, timeout_s))
     return report
+
+
+def _bake_single(server: InferenceServer, dirname: str,
+                 prev_version: str,
+                 baseline: Tuple[Optional[float], float],
+                 bake_s: float, factor: float,
+                 inflight: Optional[str],
+                 timeout_s: float) -> Dict[str, Any]:
+    """Single-server bake-then-commit: serve ``bake_s`` seconds on the
+    fresh model, then compare the windowed signals against the
+    pre-flip baseline.  Pass → promoted; breach → swap back to the
+    predecessor artifact and record the reason on ``/healthz``."""
+    base_p99, base_err = baseline
+    time.sleep(bake_s)
+    p99, err = _window_signals()
+    reason = _canary_verdict(p99, err, base_p99, base_err, factor)
+    out: Dict[str, Any] = {
+        "canary": {"bake_s": bake_s,
+                   "baseline_p99_s": base_p99, "p99_s": p99,
+                   "baseline_error_rate_s": base_err,
+                   "error_rate_s": err}}
+    if reason is None:
+        out["canary"]["result"] = "promoted"
+        _count_canary("promoted")
+        log.info("canary bake promoted %s (p99 %.1fms vs baseline "
+                 "%.1fms)", os.path.basename(dirname),
+                 (p99 or 0.0) * 1e3, (base_p99 or 0.0) * 1e3)
+        return out
+    out["canary"].update(result="rolled_back", reason=reason)
+    out.update(result="rolled_back", error=reason)
+    prev_dir = previous_artifact_dir(dirname, prev_version)
+    if prev_dir is not None:
+        rb = swap_from_artifact(server, prev_dir, inflight=inflight,
+                                timeout_s=timeout_s, canary=False)
+        out["canary"]["rollback"] = rb.get("result")
+    else:
+        out["canary"]["rollback"] = "no_predecessor"
+        log.error("canary bake breached but predecessor artifact for "
+                  "%r is gone; serving stays on the canary", prev_version)
+    # AFTER the rollback swap (which clears the swap-error state): the
+    # bake verdict is what /healthz must carry
+    server.record_swap_failure(f"canary bake: {reason}")
+    _count_canary("rolled_back")
+    log.error("canary bake rolled back %s (%s)",
+              os.path.basename(dirname), reason)
+    return out
 
 
 # ------------------------------------------------------------- watcher
@@ -485,16 +617,38 @@ class RollingCoordinator:
     a sick replica is how availability is lost, skipping is how it is
     kept) — then swap, then post-check: a failed swap or a freshly
     swapped replica going degraded HALTS the rollout so every
-    not-yet-walked replica keeps serving the old version."""
+    not-yet-walked replica keeps serving the old version.
+
+    With ``--rollout_canary`` the walk gains the **canary bake
+    policy**: the first healthy replica swaps alone and bakes for
+    ``--rollout_bake_s``, its windowed p99 TTFT / error rate (pushed
+    on its fleet frames) compared against the POOLED remaining
+    baseline replicas each poll.  A breach rolls the canary back to
+    the predecessor artifact (reason lands on its ``/healthz``) and
+    HALTS; a canary that vanishes mid-bake (fleet status missing —
+    e.g. SIGKILL) halts without a rollback target; only a clean bake
+    lets the remaining replicas walk.  Outcomes land on
+    ``rollout_canary_total{result}``."""
 
     def __init__(self, fleet_addr: str,
                  replicas: Sequence[Tuple[str, str]],
                  inflight: Optional[str] = None,
-                 swap_timeout_s: float = 120.0):
+                 swap_timeout_s: float = 120.0,
+                 canary: Optional[bool] = None,
+                 bake_s: Optional[float] = None,
+                 canary_factor: Optional[float] = None,
+                 poll_s: float = 0.5):
         self.fleet_addr = fleet_addr
         self.replicas = list(replicas)
         self.inflight = inflight
         self.swap_timeout_s = swap_timeout_s
+        self.canary = bool(FLAGS.get("rollout_canary")) \
+            if canary is None else bool(canary)
+        self.bake_s = float(FLAGS.get("rollout_bake_s")) \
+            if bake_s is None else float(bake_s)
+        self.canary_factor = float(FLAGS.get("rollout_canary_factor")) \
+            if canary_factor is None else float(canary_factor)
+        self.poll_s = float(poll_s)
 
     def _fleet_status(self, name: str) -> str:
         from ..observe.fleet import _http_get
@@ -506,6 +660,42 @@ class RollingCoordinator:
             return "missing"
         return str(doc.get("procs", {}).get(name, {}).get(
             "status", "missing"))
+
+    def _fleet_topology(self) -> Dict[str, Any]:
+        from ..observe.fleet import _http_get
+
+        try:
+            doc = json.loads(_http_get(self.fleet_addr,
+                                       "/fleet/topology"))
+        except (OSError, ValueError) as e:
+            log.warning("coordinator: fleet topology unreachable (%s)",
+                        e)
+            return {}
+        return doc.get("procs", {})
+
+    def _bake_signals(self, canary_name: str
+                      ) -> Tuple[Optional[float], float,
+                                 Optional[float], float]:
+        """(canary p99, canary err, pooled baseline p99, pooled
+        baseline err) straight off the replicas' fleet frames."""
+        procs = self._fleet_topology()
+        c = procs.get(canary_name, {})
+        c_p99 = c.get("ttft_p99_s")
+        c_err = float(c.get("error_rate_s") or 0.0)
+        base_p99s, base_errs = [], []
+        for name, _ in self.replicas:
+            if name == canary_name:
+                continue
+            p = procs.get(name, {})
+            if p.get("ttft_p99_s") is not None:
+                base_p99s.append(float(p["ttft_p99_s"]))
+            base_errs.append(float(p.get("error_rate_s") or 0.0))
+        base_p99 = sum(base_p99s) / len(base_p99s) if base_p99s \
+            else None
+        base_err = sum(base_errs) / len(base_errs) if base_errs \
+            else 0.0
+        return (None if c_p99 is None else float(c_p99), c_err,
+                base_p99, base_err)
 
     def _step(self, name: str, addr: str, artifact: str
               ) -> Dict[str, Any]:
@@ -543,26 +733,128 @@ class RollingCoordinator:
                 result="ok" if step["action"] == "swapped" else "halted")
         return step
 
+    def _bake_fleet(self, name: str, addr: str, artifact: str,
+                    prev_version: str) -> Dict[str, Any]:
+        """Bake the freshly swapped canary: each ``poll_s`` read the
+        fleet for its status and windowed signals vs the pooled
+        baseline until ``bake_s`` elapses.  ``result`` is ``promoted``
+        (clean bake), ``rolled_back`` (signal breach — the canary was
+        swapped back to the predecessor with the reason), or
+        ``missing`` (the canary vanished mid-bake; nothing to roll
+        back, the halt keeps the baselines untouched)."""
+        out: Dict[str, Any] = {"replica": name, "bake_s": self.bake_s}
+        deadline = time.monotonic() + self.bake_s
+        reason: Optional[str] = None
+        while True:
+            status = self._fleet_status(name)
+            if status == "missing":
+                out.update(result="missing",
+                           reason="canary vanished mid-bake (fleet "
+                                  "status missing)")
+                _count_canary("missing")
+                log.error("coordinator: canary %s went missing "
+                          "mid-bake; halting", name)
+                return out
+            c_p99, c_err, b_p99, b_err = self._bake_signals(name)
+            out.update(p99_s=c_p99, error_rate_s=c_err,
+                       baseline_p99_s=b_p99,
+                       baseline_error_rate_s=b_err)
+            reason = _canary_verdict(c_p99, c_err, b_p99, b_err,
+                                     self.canary_factor)
+            if reason is not None:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                out["result"] = "promoted"
+                _count_canary("promoted")
+                log.info("coordinator: canary %s promoted after "
+                         "%.1fs bake", name, self.bake_s)
+                return out
+            time.sleep(min(self.poll_s, max(remaining, 0.01)))
+        out.update(result="rolled_back", reason=reason)
+        prev_dir = previous_artifact_dir(artifact, prev_version)
+        if prev_dir is not None:
+            # the "reason" key makes the replica record the bake
+            # verdict on its /healthz after the rollback swap lands
+            code, doc = _http_post_json(
+                addr, "/v1/swap",
+                {"artifact": prev_dir,
+                 "reason": f"canary bake: {reason}",
+                 **({"inflight": self.inflight}
+                    if self.inflight else {})},
+                timeout_s=self.swap_timeout_s)
+            out["rollback"] = doc.get("result") if code == 200 \
+                else f"failed({code})"
+        else:
+            out["rollback"] = "no_predecessor"
+        _count_canary("rolled_back")
+        log.error("coordinator: canary %s rolled back (%s)", name,
+                  reason)
+        return out
+
+    def _halt(self, report: Dict[str, Any], name: str,
+              step: Dict[str, Any]) -> None:
+        report["result"] = "halted"
+        log.error("coordinator: rollout halted at %s "
+                  "(swap=%s post_status=%s)", name,
+                  (step.get("swap") or {}).get("result"),
+                  step.get("post_status"))
+
     def rollout(self, artifact: str) -> Dict[str, Any]:
         """Walk the replicas; returns the rollout report.  ``result``
         is ``ok`` when every healthy replica swapped (skipped replicas
         are reported, not fatal), ``halted`` when a swap failed or a
         swapped replica degraded — the walk stops there and every
-        remaining replica keeps the old version."""
+        remaining replica keeps the old version.
+
+        Canary mode (``self.canary``, ≥ 2 replicas): the first healthy
+        replica swaps and bakes (:meth:`_bake_fleet`) BEFORE anyone
+        else moves; only ``promoted`` lets the walk continue, and the
+        bake verdict rides the report under ``"canary"``."""
         report: Dict[str, Any] = {"artifact": artifact, "steps": [],
                                   "result": "ok"}
         with _span_coordinator(artifact=os.path.basename(artifact),
                                replicas=len(self.replicas)):
-            for name, addr in self.replicas:
+            walk = list(self.replicas)
+            if self.canary and len(walk) > 1:
+                baked = self._canary_leg(report, walk, artifact)
+                if not baked:
+                    walk = []
+            for name, addr in walk:
                 step = self._step(name, addr, artifact)
                 report["steps"].append(step)
                 if step["action"] == "halt":
-                    report["result"] = "halted"
-                    log.error("coordinator: rollout halted at %s "
-                              "(swap=%s post_status=%s)", name,
-                              (step.get("swap") or {}).get("result"),
-                              step.get("post_status"))
+                    self._halt(report, name, step)
                     break
         report["skipped"] = [s["replica"] for s in report["steps"]
                              if s["action"] == "skipped"]
         return report
+
+    def _canary_leg(self, report: Dict[str, Any],
+                    walk: List[Tuple[str, str]], artifact: str) -> bool:
+        """Swap + bake the canary (first HEALTHY replica); consumes the
+        walked prefix of ``walk`` in place.  True iff the remaining
+        replicas may proceed."""
+        while walk:
+            name, addr = walk.pop(0)
+            # the canary's pre-swap artifact digest is the rollback
+            # target — read it before the swap changes it
+            prev_version = str(self._fleet_topology().get(
+                name, {}).get("model_version") or "")
+            step = self._step(name, addr, artifact)
+            report["steps"].append(step)
+            if step["action"] == "halt":
+                self._halt(report, name, step)
+                return False
+            if step["action"] == "swapped":
+                bake = self._bake_fleet(name, addr, artifact,
+                                        prev_version)
+                report["canary"] = bake
+                if bake["result"] != "promoted":
+                    report["result"] = "halted"
+                    log.error("coordinator: rollout halted — canary "
+                              "%s bake %s", name, bake["result"])
+                    return False
+                return True
+            # skipped: try the next replica as the canary
+        return False   # nobody healthy enough to canary on

@@ -68,12 +68,13 @@ from .model import DecoderModel
 from .pagepool import PagePool, PagePoolExhausted, SCRATCH_PAGE, TornSnapshot
 
 try:                         # telemetry optional, as in loader.py
+    from ..observe import REGISTRY as _registry
     from ..observe import counter as _counter, gauge as _gauge
     from ..observe import histogram as _histogram, trace as _trace
     from ..observe import fleet as _fleet
     from ..observe.http import make_threading_server, resolve_bind_host
 except ImportError:  # pragma: no cover - standalone copy
-    _counter = _gauge = _histogram = _trace = _fleet = None
+    _counter = _gauge = _histogram = _trace = _fleet = _registry = None
     make_threading_server = resolve_bind_host = None
 
 log = get_logger("serving")
@@ -352,6 +353,19 @@ class InferenceServer:
             # (and with it the /healthz body) byte-identical to the
             # pre-rollout server
             out.update(rollout)
+        slo_ms = float(FLAGS.get("serve_slo_ms") or 0.0)
+        if slo_ms > 0 and _registry is not None:
+            # WINDOWED p99 (last 60s), not the lifetime reservoir: a
+            # recovered server must stop advertising a stale bad p99
+            # forever.  Gated on the flag (default 0) so the default
+            # /healthz body stays byte-identical.
+            h = _registry.find("serve_ttft_seconds")
+            p99 = h.window_quantile(0.99, 60.0) \
+                if h is not None and hasattr(h, "window_quantile") \
+                else None
+            out["ttft_p99_ms"] = None if p99 is None \
+                else round(p99 * 1e3, 3)
+            out["slo_met"] = int(p99 is None or p99 * 1e3 <= slo_ms)
         return out
 
     # ------------------------------------------------------------ hot swap
@@ -526,6 +540,14 @@ class InferenceServer:
                     r.state = "failed"
                     r.error = f"{type(e).__name__}: {e}"
                     r.done.set()
+                    if _histogram is not None:
+                        # unit events: window_rate = failures/s — the
+                        # canary bake's error-rate signal and the
+                        # --slo rate-objective source
+                        _histogram("serve_request_failures",
+                                   "failed requests as unit events "
+                                   "(windowed rate = failures/sec)"
+                                   ).observe(1.0)
                 changed = True
             if changed and self.snapshot_path:
                 self.pool.snapshot(self.snapshot_path)
@@ -571,6 +593,14 @@ class InferenceServer:
             tokens[i, :len(r.prompt)] = r.prompt
             lengths[i] = len(r.prompt)
             tables[i] = self._table_row(r)
+        # testing/bench knob: a seeded-slow artifact (manifest
+        # debug_prefill_delay_ms) inflates TTFT here — inside the
+        # TTFT stamp, before the launch — so a canary bake has a
+        # deterministic latency regression to detect.  Swap probes
+        # call model.prefill directly and never pay it.
+        delay = getattr(self.model, "debug_prefill_delay_s", 0.0)
+        if delay:
+            time.sleep(delay)
         nxt, _, self._k_pool, self._v_pool = self.model.prefill(
             self._k_pool, self._v_pool, tokens, lengths, tables)
         now = time.perf_counter()
@@ -715,6 +745,13 @@ def _make_handler(server: InferenceServer):
                     server, body["artifact"],
                     inflight=body.get("inflight"))
                 ok = report.get("result") in ("ok", "unchanged")
+                if ok and body.get("reason"):
+                    # a coordinator-driven ROLLBACK swap: the swap
+                    # itself succeeded (back to the old artifact) but
+                    # the reason — e.g. a failed canary bake — must
+                    # land on /healthz as a rolled_back state
+                    server.record_swap_failure(str(body["reason"]))
+                    report = dict(report, reason=str(body["reason"]))
                 self._send(200 if ok else 500, report)
             except BrokenPipeError:
                 pass

@@ -382,7 +382,20 @@ FLAGS.define("kv_page_size", 16,
 FLAGS.define("serve_slo_ms", 0.0,
              "optional p99 TTFT SLO in milliseconds: when > 0 the "
              "server's /healthz and the bench serving lane report "
-             "slo_met from the serve_ttft_seconds reservoir p99")
+             "ttft_p99_ms and slo_met from the serve_ttft_seconds "
+             "WINDOWED reservoir p99 (last ~60s), so a recovered "
+             "server stops advertising a stale lifetime p99; 0 "
+             "(default) leaves /healthz byte-identical")
+FLAGS.define("slo", "",
+             "declarative serving SLOs evaluated continuously on the "
+             "reporter thread (observe/slo.py): objectives joined "
+             "with ',' or ';' in metric:statOPthreshold:window "
+             "grammar, e.g. 'serve_ttft_seconds:p99<0.5:60s' (stat "
+             "pNN windowed quantile or rate events/s, OP < or >, "
+             "window Ns/Nm).  Each yields ok/breach plus fast+slow "
+             "multi-window burn rates on slo_status/slo_burn_rate "
+             "gauges, /slo, /healthz, and the fleet plane.  Empty "
+             "(default) = no engine, every surface byte-identical")
 FLAGS.define("rollout", True,
              "the zero-downtime train->serve pipeline "
              "(serving/rollout.py): checkpoint watcher + atomic "
@@ -413,6 +426,30 @@ FLAGS.define("rollout_export_dir", "",
              "directory the checkpoint watcher writes serving "
              "artifacts into (model-<digest> dirs, atomic tmp+rename; "
              "empty = <save_dir>/export)")
+FLAGS.define("rollout_canary", False,
+             "canary bake policy for rollouts (serving/rollout.py): "
+             "the RollingCoordinator swaps ONE replica first and "
+             "bakes it for --rollout_bake_s, comparing the canary's "
+             "windowed p99 TTFT and error rate against the pooled "
+             "baseline replicas via the fleet aggregator; on breach "
+             "the canary is auto-rolled-back and the rollout HALTS "
+             "(reason on /healthz, rollout_canary_total{result}), "
+             "otherwise the remaining replicas swap.  Single-server "
+             "swaps get the same bake-then-commit window.  false "
+             "(default) = PR-18 behavior, byte-identical")
+FLAGS.define("rollout_bake_s", 0.0,
+             "canary bake duration in seconds (--rollout_canary): "
+             "how long a freshly swapped canary serves traffic "
+             "before its windowed p99 TTFT / error rate is compared "
+             "against the baseline pool and the rollout commits or "
+             "rolls back; 0 with --rollout_canary still does the "
+             "one-replica-first walk but skips the bake wait")
+FLAGS.define("rollout_canary_factor", 2.0,
+             "canary breach threshold (--rollout_canary): the bake "
+             "fails when canary windowed p99 TTFT > factor x pooled "
+             "baseline p99, or canary error rate > factor x baseline "
+             "error rate (any canary errors breach when the baseline "
+             "pool is error-free)")
 FLAGS.define("ckpt_export_lease_s", 600.0,
              "stale-mtime expiry for .exporting-<pid> checkpoint pin "
              "markers (trainer/checkpoint.py): the retention sweep "

@@ -189,6 +189,10 @@ def _reset_global_state(_io_thread_leak_guard):
     hmod = _sys.modules.get("paddle_tpu.observe.health")
     if hmod is not None:
         hmod.reset()
+    # same discipline for the SLO engine (observe/slo.py)
+    smod = _sys.modules.get("paddle_tpu.observe.slo")
+    if smod is not None:
+        smod.reset()
 
 
 # Thread-leak guard: every framework-owned service thread is named so

@@ -693,3 +693,231 @@ def test_rollout_metrics_served_on_metrics_endpoint(cfg, tmp_path):
     assert "# TYPE rollout_swap_seconds histogram" in text
     assert "rollout_swap_seconds_count" in text
     assert f'rollout_model_version{{digest="{digest}"}} 1.0' in text
+
+
+# ------------------------------------------------------- canary bake
+def _export_as_rollback_target(cfg, export_dir, seed, **extra):
+    """Export under the canonical ``model-<digest12>`` name so a later
+    canary rollback (``previous_artifact_dir``) can find it."""
+    from paddle_tpu.serving import rollout as ro
+    from paddle_tpu.serving.model import export_decoder
+
+    tmp = os.path.join(str(export_dir), f".stage-{seed}")
+    export_decoder({k: np.asarray(v) for k, v in
+                    _params(cfg, seed).items()}, cfg, tmp, **extra)
+    digest = artifact_digest(read_manifest(tmp))
+    final = os.path.join(str(export_dir),
+                         f"{ro.ARTIFACT_PREFIX}{digest[:12]}")
+    os.rename(tmp, final)
+    return final, digest
+
+
+class _Traffic:
+    """Background request stream against an in-process server; counts
+    successes and records any client-visible failure — the bake's
+    zero-failed-requests property is judged on THIS ledger."""
+
+    def __init__(self, srv):
+        self.srv = srv
+        self.served = 0
+        self.errors = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            try:
+                toks = self.srv.generate([2 + (i % 60)] * 3, 2,
+                                         timeout=120.0)
+                assert toks
+                self.served += 1
+            except Exception as e:   # noqa: BLE001 — the assertion ledger
+                self.errors.append(repr(e))
+            i += 1
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=120.0)
+
+
+def _warm_window(min_samples=250, timeout_s=60.0):
+    """Block until the serve TTFT window holds enough samples that one
+    cold-start compile outlier sits above the p99 order statistic."""
+    h = observe.REGISTRY.find("serve_ttft_seconds")
+    deadline = time.monotonic() + timeout_s
+    while h is None or h.window_count(60.0) < min_samples:
+        assert time.monotonic() < deadline, "baseline never warmed"
+        time.sleep(0.05)
+        h = observe.REGISTRY.find("serve_ttft_seconds")
+
+
+@pytest.mark.slow
+def test_canary_bake_rolls_back_slow_artifact_zero_failures(
+        cfg, tmp_path):
+    """The ISSUE-20 acceptance pin, single-server: an artifact with an
+    injected latency regression (manifest ``debug_prefill_delay_ms``)
+    is detected by the bake and auto-rolled-back with ZERO failed
+    requests; a clean artifact then bakes and promotes."""
+    from paddle_tpu.serving import rollout as ro
+
+    exp = tmp_path / "export"
+    os.makedirs(exp)
+    good, dig_good = _export_as_rollback_target(cfg, exp, seed=1)
+    slow, _ = _export_as_rollback_target(
+        cfg, exp, seed=2, extra_meta={"debug_prefill_delay_ms": 250})
+    better, dig_better = _export_as_rollback_target(cfg, exp, seed=3)
+
+    with _server(cfg, seed=0, continuous=True) as srv:
+        srv.start()
+        port = srv.start_http(0)
+        with _Traffic(srv) as traffic:
+            # land the baseline version (no canary) and warm its
+            # windowed p99 past the cold-start compile outlier
+            assert ro.swap_from_artifact(srv, good)["result"] == "ok"
+            _warm_window()
+
+            rep = ro.swap_from_artifact(srv, slow, canary=True,
+                                        bake_s=1.2, canary_factor=2.0)
+            assert rep["result"] == "rolled_back"
+            can = rep["canary"]
+            assert can["result"] == "rolled_back"
+            assert can["rollback"] == "ok"
+            assert "p99 TTFT" in can["reason"]
+            assert can["p99_s"] > 2.0 * can["baseline_p99_s"]
+            # the regression never sticks: predecessor version serving,
+            # the bake verdict on /healthz
+            assert srv.model_version == dig_good
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=30) as resp:
+                health = json.loads(resp.read())
+            assert health["rollout_state"] == "rolled_back"
+            assert health["last_swap_error"].startswith("canary bake:")
+            assert health["model_version"] == dig_good
+
+            # a clean artifact bakes and PROMOTES through the same path
+            rep2 = ro.swap_from_artifact(srv, better, canary=True,
+                                         bake_s=1.2, canary_factor=2.0)
+            assert rep2["result"] == "ok"
+            assert rep2["canary"]["result"] == "promoted"
+            assert srv.model_version == dig_better
+
+        # zero failed requests across both bakes + both swaps — the
+        # client ledger AND the server-side failure histogram agree
+        assert traffic.errors == []
+        assert traffic.served > 0
+        errs = observe.REGISTRY.find("serve_request_failures")
+        assert errs is None or errs.window_count(60.0) == 0
+    assert observe.counter("rollout_canary_total",
+                           "").value(result="rolled_back") == 1
+    assert observe.counter("rollout_canary_total",
+                           "").value(result="promoted") == 1
+
+
+def test_canary_kill_switch_swap_report_identical(cfg, tmp_path):
+    """Both directions of the canary kill switch: with the flags unset
+    (or bake_s=0) the swap report carries NO ``canary`` key — byte-
+    identical to the PR-18 report; enabling ``serve_slo_ms`` is what
+    adds the windowed stats keys."""
+    from paddle_tpu.serving import rollout as ro
+
+    art = _export(cfg, tmp_path / "a", seed=1)
+    with _server(cfg, seed=0) as srv:
+        rep = ro.swap_from_artifact(srv, art)       # flags at defaults
+        assert rep["result"] == "ok" and "canary" not in rep
+        art2 = _export(cfg, tmp_path / "b", seed=2)
+        rep = ro.swap_from_artifact(srv, art2, canary=True, bake_s=0.0)
+        assert rep["result"] == "ok" and "canary" not in rep
+        # positive direction of the serve_slo_ms switch (the zero side
+        # is pinned by test_kill_switch_server_byte_identical)
+        with _flag("serve_slo_ms", 250.0):
+            st = srv.stats()
+            assert "ttft_p99_ms" in st and "slo_met" in st
+
+
+def test_coordinator_canary_promotes_then_walks(cfg, tmp_path):
+    """Fleet-side canary: the first replica swaps alone, bakes against
+    the pooled baseline signals riding the fleet frames, and only a
+    clean bake lets the remaining replicas walk."""
+    from paddle_tpu.observe.fleet import FleetAggregator
+    from paddle_tpu.serving import rollout as ro
+
+    art = _export(cfg, tmp_path / "a", seed=1)
+    digest = artifact_digest(read_manifest(art))
+    with FleetAggregator(0) as agg, \
+            _server(cfg, seed=0) as canary, _server(cfg, seed=0) as base:
+        cport, bport = canary.start_http(0), base.start_http(0)
+        _ingest(agg, "serve-canary", pid=101, serving={
+            "model_version": "unversioned", "rollout_state": "serving",
+            "ttft_p99_s": 0.0012, "error_rate_s": 0.0})
+        _ingest(agg, "serve-base", pid=102, serving={
+            "model_version": "unversioned", "rollout_state": "serving",
+            "ttft_p99_s": 0.0010, "error_rate_s": 0.0})
+        coord = ro.RollingCoordinator(agg.addr, [
+            ("serve-canary", f"127.0.0.1:{cport}"),
+            ("serve-base", f"127.0.0.1:{bport}"),
+        ], canary=True, bake_s=0.3, canary_factor=2.0, poll_s=0.05)
+        report = coord.rollout(art)
+        assert report["result"] == "ok"
+        assert report["canary"]["result"] == "promoted"
+        assert report["canary"]["replica"] == "serve-canary"
+        assert [s["action"] for s in report["steps"]] == \
+            ["swapped", "swapped"]
+        assert canary.model_version == digest
+        assert base.model_version == digest
+    assert observe.counter("rollout_canary_total",
+                           "").value(result="promoted") == 1
+
+
+def test_coordinator_canary_rolls_back_and_halts(cfg, tmp_path):
+    """Fleet-side breach: the canary's windowed p99 (off its frames)
+    blows past the pooled baseline, the coordinator rolls it back to
+    the predecessor artifact (bake verdict on the replica's /healthz)
+    and HALTS — the baseline replicas never swap."""
+    from paddle_tpu.observe.fleet import FleetAggregator
+    from paddle_tpu.serving import rollout as ro
+
+    exp = tmp_path / "export"
+    os.makedirs(exp)
+    prev_art, dig_prev = _export_as_rollback_target(cfg, exp, seed=1)
+    new_art, _ = _export_as_rollback_target(cfg, exp, seed=2)
+
+    with FleetAggregator(0) as agg, \
+            _server(cfg, seed=0) as canary, _server(cfg, seed=0) as base:
+        cport, bport = canary.start_http(0), base.start_http(0)
+        # the canary advertises its pre-swap version (the rollback
+        # target) and a 50 ms windowed p99; the pool holds 1 ms
+        _ingest(agg, "serve-canary", pid=101, serving={
+            "model_version": dig_prev, "rollout_state": "serving",
+            "ttft_p99_s": 0.050, "error_rate_s": 0.0})
+        _ingest(agg, "serve-base", pid=102, serving={
+            "model_version": dig_prev, "rollout_state": "serving",
+            "ttft_p99_s": 0.001, "error_rate_s": 0.0})
+        coord = ro.RollingCoordinator(agg.addr, [
+            ("serve-canary", f"127.0.0.1:{cport}"),
+            ("serve-base", f"127.0.0.1:{bport}"),
+        ], canary=True, bake_s=30.0, canary_factor=2.0, poll_s=0.05)
+        report = coord.rollout(new_art)
+        assert report["result"] == "halted"
+        can = report["canary"]
+        assert can["result"] == "rolled_back"
+        assert can["rollback"] == "ok"
+        assert "p99 TTFT" in can["reason"]
+        assert len(report["steps"]) == 1        # baselines never walked
+        # the canary is back on the predecessor, verdict on /healthz
+        assert canary.model_version == dig_prev
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{cport}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["rollout_state"] == "rolled_back"
+        assert health["last_swap_error"].startswith("canary bake:")
+        # the not-yet-walked replica was never touched
+        assert base.model_version == "unversioned"
+        assert base.rollout_state == "serving"
+    assert observe.counter("rollout_canary_total",
+                           "").value(result="rolled_back") == 1

@@ -322,3 +322,84 @@ def test_journey_merged_trace_and_fleet_rollout_metrics(cfg, tmp_path):
             tr.kill()
             ex.kill()
             sv.kill()
+
+
+# ------------------------------- SIGKILL: the canary, mid-bake
+def test_sigkill_canary_mid_bake_halts_and_baselines_never_swap(
+        cfg, tmp_path):
+    """The canary replica takes SIGKILL in the middle of its bake: its
+    fleet frames stop, staleness flips it to ``missing``, and the
+    coordinator HALTS with ``rollout_canary_total{result="missing"}``
+    — no rollback target is POSTed at a corpse, and the baseline
+    replicas never receive a swap (they keep serving the old version
+    throughout)."""
+    import threading
+    import urllib.request
+
+    from paddle_tpu import observe
+
+    baseline_dir = str(tmp_path / "baseline_export")
+    v0 = _publish(cfg, tmp_path, baseline_dir, seed=0, tag="v0")
+    # the candidate lives in a dir the children's own watchers never
+    # scan — only the coordinator lands it, so the kill is the only
+    # reason it fails to spread
+    candidate_dir = str(tmp_path / "candidate_export")
+    v1 = _publish(cfg, tmp_path, candidate_dir, seed=1, tag="v1")
+    new_art = os.path.join(candidate_dir,
+                           f"{ro.ARTIFACT_PREFIX}{v1[:12]}")
+
+    def _healthz(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            return json.loads(r.read())
+
+    with FleetAggregator(0) as agg:
+        can = fault.RolloutServeProcess(
+            baseline_dir, poll_s=3600, serve_load=False,
+            fleet_addr=agg.addr, fleet_id="serve-canary")
+        base = fault.RolloutServeProcess(
+            baseline_dir, poll_s=3600, serve_load=False,
+            fleet_addr=agg.addr, fleet_id="serve-base")
+        try:
+            can.start()
+            base.start()
+            assert can.boot_version == v0 and base.boot_version == v0
+            _wait_for(lambda: all(
+                agg.state.rollup()["procs"].get(n, {}).get("status")
+                == "ok" for n in ("serve-canary", "serve-base")),
+                what="both replicas ok in the fleet rollup")
+
+            coord = ro.RollingCoordinator(agg.addr, [
+                ("serve-canary", can.addr),
+                ("serve-base", base.addr),
+            ], canary=True, bake_s=60.0, canary_factor=100.0,
+                poll_s=0.1)
+            result = {}
+
+            def _run():
+                result["report"] = coord.rollout(new_art)
+
+            t = threading.Thread(target=_run, name="test-coordinator")
+            t.start()
+            # the bake is underway once the canary serves v1; SIGKILL
+            # lands there — frames stop, staleness flips it missing
+            _wait_for(lambda: _healthz(can.port)["model_version"] == v1,
+                      what="canary swapped to the candidate")
+            can.kill()
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "coordinator never returned"
+
+            report = result["report"]
+            assert report["result"] == "halted"
+            assert report["canary"]["result"] == "missing"
+            assert "rollback" not in report["canary"]
+            assert len(report["steps"]) == 1   # baselines never walked
+            # the baseline replica kept the old version the whole time
+            hz = _healthz(base.port)
+            assert hz["model_version"] == v0
+            assert hz["rollout_state"] == "serving"
+            assert observe.counter("rollout_canary_total",
+                                   "").value(result="missing") == 1
+        finally:
+            can.kill()
+            base.kill()
